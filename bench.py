@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Driver benchmark: ResNet-50/ImageNet images/sec/chip + MFU (BASELINE.json metric).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` reports achieved MFU / 0.55 — the north star's MFU target —
+which is hardware-normalized and therefore comparable across chip types.
+
+Measures the compiled train step on device-resident synthetic batches
+(input pipeline excluded, as a synthetic-data reference run would); steady
+state over ``--steps`` steps after ``--warmup`` dispatches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench(model_name: str = "resnet50", image_size: int = 224,
+          per_chip_batch: int = 128, steps: int = 30, warmup: int = 10,
+          precision: str = "bf16", quiet: bool = True):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib, optim, precision as precision_lib, train_loop)
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
+    from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+    from pytorch_distributed_training_example_tpu.utils.config import from_preset
+
+    n_chips = jax.device_count()
+    global_batch = per_chip_batch * n_chips
+    cfg = from_preset("resnet50_imagenet", global_batch_size=global_batch,
+                      precision=precision)
+
+    policy = precision_lib.get_policy(cfg.precision)
+    bundle = registry.create_model(model_name, num_classes=cfg.num_classes,
+                                   image_size=image_size,
+                                   dtype=policy.compute_dtype,
+                                   param_dtype=policy.param_dtype)
+    mesh = mesh_lib.build_mesh({"data": -1})
+    tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
+    rules = sharding_lib.strategy_rules(cfg.strategy, bundle.rules)
+    state = train_loop.create_train_state(bundle.module, tx,
+                                          bundle.input_template, mesh, rules,
+                                          seed=0)
+    task = train_loop.get_task(bundle.task)
+    step = jax.jit(train_loop.make_train_step(task), donate_argnums=0)
+    warmup = max(warmup, 1)  # at least one dispatch so `metrics` exists
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.randn(global_batch, image_size, image_size, 3).astype(np.float32),
+        "label": (np.arange(global_batch) % cfg.num_classes).astype(np.int32),
+    }
+    from pytorch_distributed_training_example_tpu.data import prefetch
+    batch = prefetch.shard_batch(batch, mesh_lib.batch_sharding(mesh))
+
+    with mesh_lib.use_mesh(mesh):
+        for _ in range(warmup):
+            state, metrics = step(state, batch)
+        jax.tree.map(lambda x: x.block_until_ready(), metrics)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        jax.tree.map(lambda x: x.block_until_ready(), metrics)
+        dt = time.perf_counter() - t0
+
+    images_per_sec = global_batch * steps / dt
+    per_chip = images_per_sec / n_chips
+    mfu = metrics_lib.mfu(per_chip, bundle.fwd_flops_per_example)
+    if not quiet:
+        print(f"# {n_chips} chip(s) ({jax.devices()[0].device_kind}), "
+              f"global batch {global_batch}, {dt/steps*1e3:.1f} ms/step, "
+              f"mfu {100*mfu:.1f}%", file=sys.stderr)
+    return {
+        "metric": f"{model_name}_imagenet_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.55, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "chips": n_chips,
+            "device": jax.devices()[0].device_kind,
+            "global_batch": global_batch,
+            "step_ms": round(dt / steps * 1e3, 2),
+            "precision": precision,
+        },
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--per-chip-batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--precision", default="bf16")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    result = bench(args.model, args.image_size, args.per_chip_batch,
+                   args.steps, args.warmup, args.precision,
+                   quiet=not args.verbose)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

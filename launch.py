@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Multi-process launcher — the ``torchrun`` equivalent (SURVEY.md §2b N8).
+
+On a real TPU pod each *host* runs one process and the TPU runtime provides
+the cluster env, so ``launch.py`` mostly matters for local multi-process CPU
+testing and for explicit on-host pods:
+
+    python launch.py --nprocs 4 -- main.py --distributed --config gpt2_124m
+
+spawns N processes with COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID set
+(plus per-process CPU device partitioning when --cpu-devices is given),
+streams rank-0 output, and propagates the first non-zero exit — torchrun's
+contract, minus elasticity (TPU slices are gang-scheduled; recovery is
+restart-from-checkpoint, SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--nprocs", type=int, default=2)
+    p.add_argument("--coordinator-port", type=int, default=None)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="fake CPU devices per process (testing without TPUs)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- script.py args...")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
+
+    port = args.coordinator_port or free_port()
+    procs = []
+    for rank in range(args.nprocs):
+        env = os.environ.copy()
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"] = str(args.nprocs)
+        env["PROCESS_ID"] = str(rank)
+        # torchrun-compatible aliases
+        env["MASTER_ADDR"], env["MASTER_PORT"] = "127.0.0.1", str(port)
+        env["WORLD_SIZE"], env["RANK"] = str(args.nprocs), str(rank)
+        if args.cpu_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            # Belt and braces: JAX_PLATFORMS_OVERRIDE is re-asserted through
+            # jax.config by main.py, surviving sitecustomize hooks that pin a
+            # TPU platform during interpreter startup.
+            env["JAX_PLATFORMS_OVERRIDE"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count={args.cpu_devices}").strip()
+        if rank == 0:
+            out = err = None
+        else:
+            out = err = open(f"/tmp/launch_rank{rank}.log", "w")
+        procs.append(subprocess.Popen([sys.executable, *cmd], env=env,
+                                      stdout=out, stderr=err))
+
+    def kill_all(*_):
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+
+    signal.signal(signal.SIGINT, kill_all)
+    signal.signal(signal.SIGTERM, kill_all)
+
+    # Poll ALL ranks: the first failure tears the job down immediately
+    # (a dead rank would otherwise leave the rest blocked in a collective
+    # and the launcher hung in a serial wait()).
+    import time
+
+    code = None
+    while code is None:
+        time.sleep(0.2)
+        rcs = [pr.poll() for pr in procs]
+        failed = [rc for rc in rcs if rc not in (None, 0)]
+        if failed:
+            code = failed[0]
+            kill_all()
+        elif all(rc == 0 for rc in rcs):
+            code = 0
+    for pr in procs:
+        try:
+            pr.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Training entrypoint — CLI-compatible with the reference's ``main.py``.
+
+The north-star contract (BASELINE.json): ``python main.py --distributed``
+launches unchanged on a TPU slice. Flag surface follows the reference's
+argparse conventions (SURVEY.md §2a #1): epochs/batch-size/lr/data-path/
+workers/resume, plus ``--config`` presets for the five reference workloads
+and mesh/strategy flags for the TPU-native parallelism that replaces DDP.
+
+Single-process mode (no ``--distributed``) is the reference's CPU-runnable
+dev path (SURVEY.md §3.5): same compiled step on whatever single host
+process + devices exist, no rendezvous.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native distributed training")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host mode: rendezvous via jax.distributed.initialize "
+                        "(the init_process_group('nccl') equivalent)")
+    p.add_argument("--config", default=None,
+                   help="preset name (resnet18_cifar10, resnet50_imagenet, "
+                        "vit_b16_imagenet, gpt2_124m, llama3_8b)")
+    p.add_argument("--model", default=None)
+    p.add_argument("--dataset", default=None)
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None, dest="global_batch_size",
+                   help="GLOBAL batch size (split across hosts/chips)")
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--optimizer", default=None, choices=["sgd", "adamw"])
+    p.add_argument("--precision", default=None,
+                   choices=["fp32", "bf16", "pure_bf16", "fp16"])
+    p.add_argument("--strategy", default=None,
+                   help="dp | fsdp | model-specific (e.g. fsdp_tp)")
+    p.add_argument("--mesh", default=None,
+                   help="axis sizes as k=v pairs, e.g. 'data=2,fsdp=4' "
+                        "(-1 absorbs remaining devices)")
+    p.add_argument("--remat", action="store_true", default=None,
+                   help="gradient checkpointing")
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="cap steps per epoch (smoke/bench runs)")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", default=None, nargs="?", const="auto",
+                   help="checkpoint dir or 'auto' (newest committed)")
+    p.add_argument("--profile-steps", default=None,
+                   help="'start:stop' global-step range to trace")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port (else env)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
+                   help="force a jax platform (dev: run the TPU code path on CPU)")
+    p.add_argument("--fake-devices", type=int, default=None,
+                   help="with --platform cpu: number of fake host devices")
+    return p
+
+
+def config_from_args(args) -> "Config":
+    from pytorch_distributed_training_example_tpu.utils.config import Config, from_preset
+
+    cfg = from_preset(args.config) if args.config else Config()
+    field_names = {f.name for f in dataclasses.fields(Config)}
+    overrides = {k: v for k, v in vars(args).items()
+                 if k in field_names and v is not None}
+    cfg = cfg.replace(**overrides)
+    if args.mesh:
+        axes = dict(kv.split("=") for kv in args.mesh.split(","))
+        cfg = cfg.replace(**{f"mesh_{k}": int(v) for k, v in axes.items()})
+    return cfg
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import os
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}"
+        ).strip()
+    # Honor --platform, falling back to the JAX_PLATFORMS env var. The env
+    # var alone is not enough here: site customizations that pre-import jax
+    # (e.g. TPU plugin registration hooks) can pin the platform before this
+    # process' env is consulted, so re-assert it through jax.config.
+    platform = args.platform or os.environ.get("JAX_PLATFORMS_OVERRIDE")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    # Bootstrap BEFORE touching jax.devices(): in multi-host mode every
+    # process must rendezvous first (SURVEY.md §3.1 boundary).
+    from pytorch_distributed_training_example_tpu.core import distributed
+
+    if args.distributed:
+        distributed.init_process_group(args.coordinator, args.num_processes,
+                                       args.process_id)
+
+    cfg = config_from_args(args)
+
+    from pytorch_distributed_training_example_tpu.core.trainer import Trainer
+
+    trainer = Trainer(cfg)
+    trainer.train()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+"""TPU-native distributed training framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework providing the capabilities of the
+reference ``ownzonefeng/pytorch-distributed-training-example`` (see SURVEY.md;
+the reference mount was empty, so parity targets come from BASELINE.json's
+``north_star`` contract):
+
+- ``main.py --distributed`` entrypoint            -> unchanged CLI surface
+- ``torch.distributed.init_process_group('nccl')``-> :func:`core.distributed.init_process_group`
+  (wraps ``jax.distributed.initialize`` over ICI/DCN)
+- ``DistributedDataParallel`` + bucketed NCCL all-reduce
+                                                  -> gradient ``psum`` fused inside ONE
+                                                     compiled XLA step over a named mesh
+- ``DistributedSampler``/``DataLoader``           -> :mod:`data` (per-host sharding + HBM prefetch)
+- ``torch.cuda.amp`` + ``GradScaler``             -> :mod:`core.precision` (native bf16 policy;
+                                                     dynamic scaler kept for fp16 parity)
+
+Parallelism is data, not code: a strategy is a table of sharding rules over the
+named mesh axes ``('data','fsdp','stage','expert','context','model')``.
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_distributed_training_example_tpu.core import mesh, precision  # noqa: F401

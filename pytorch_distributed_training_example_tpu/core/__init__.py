@@ -1,0 +1,1 @@
+"""Core runtime: mesh construction, precision policy, train state/loop, distributed bootstrap."""

@@ -1,0 +1,197 @@
+"""Named device-mesh construction — the TPU-native replacement for process groups.
+
+Reference parity (SURVEY.md §2d): the reference's communication substrate is a
+c10d ``ProcessGroup`` over NCCL, created by ``init_process_group('nccl')``.
+On TPU the substrate is the XLA partitioner over a :class:`jax.sharding.Mesh`:
+you never hand-write transport code — you declare *named axes* and shardings
+and XLA emits ICI/DCN collectives inside the compiled step.
+
+Axis convention (DCN-major ordering — the outermost axis crosses the slowest
+interconnect, so pure data-parallel gradient reduction is what rides DCN in
+multislice, while TP/CP collectives stay on ICI):
+
+    data    — pure data parallelism (gradient psum; replicated params)
+    fsdp    — data parallelism with parameter/optimizer sharding (ZeRO-3)
+    stage   — pipeline-parallel stage axis
+    expert  — MoE expert parallelism
+    context — sequence/context parallelism (ring attention / Ulysses)
+    model   — tensor (Megatron-style) model parallelism
+
+A batch is sharded over ``('data','fsdp')`` jointly; any axis of size 1 is
+free (GSPMD ignores it), so one 6-axis mesh serves every strategy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "model")
+
+#: Axes over which the batch dimension is sharded (both are "data parallel"
+#: axes from the input pipeline's point of view).
+BATCH_AXES: tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``data=-1`` absorbs all remaining devices.
+
+    The product of all axis sizes must equal the device count (after ``-1``
+    expansion). This mirrors how the reference picks ``world_size`` from the
+    launcher (SURVEY.md §3.1) — here the "world" is the device mesh.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    stage: int = 1
+    expert: int = 1
+    context: int = 1
+    model: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.stage, self.expert, self.context, self.model)
+
+    def resolve(self, num_devices: int) -> tuple[int, ...]:
+        sizes = list(self.sizes())
+        fixed = math.prod(s for s in sizes if s != -1)
+        n_wild = sum(1 for s in sizes if s == -1)
+        if n_wild > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if n_wild == 1:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[sizes.index(-1)] = num_devices // fixed
+        if math.prod(sizes) != num_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} needs {math.prod(sizes)} devices, "
+                f"have {num_devices}"
+            )
+        return tuple(sizes)
+
+
+def build_mesh(
+    config: MeshConfig | dict | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the named device mesh.
+
+    Uses ``mesh_utils.create_device_mesh`` so the logical mesh is laid out
+    along the physical ICI torus (nearest-neighbor axes get the fastest
+    links); falls back to a plain reshape for CPU/fake devices.
+    """
+    if config is None:
+        config = MeshConfig()
+    elif isinstance(config, dict):
+        config = MeshConfig(**config)
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    shape = config.resolve(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """1-device mesh (the reference's non-``--distributed`` path, SURVEY.md §3.5)."""
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape((1,) * len(AXES)), AXES)
+
+
+# ---------------------------------------------------------------------------
+# Current-mesh context: lets model code apply sharding constraints without
+# threading the mesh through every call signature.
+# ---------------------------------------------------------------------------
+
+_local = threading.local()
+
+
+def current_mesh() -> Mesh | None:
+    return getattr(_local, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Make ``mesh`` the ambient mesh for :func:`constrain` and friends."""
+    prev = current_mesh()
+    _local.mesh = mesh
+    try:
+        # jax's own set_mesh/use_mesh contextmanager (when present) lets bare
+        # PartitionSpecs be used inside jit bodies.
+        ctx = getattr(jax.sharding, "use_mesh", None)
+        if ctx is not None:
+            with ctx(mesh):
+                yield mesh
+        else:
+            yield mesh
+    finally:
+        _local.mesh = prev
+
+
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` against the ambient mesh (no-op without one).
+
+    Drops axis names that the ambient mesh does not have at size > 1, so model
+    code can always annotate the "full" spec (e.g. activations sharded over
+    ``('data','fsdp')`` and ``'model'``) and run unmodified on any mesh shape.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = _prune_spec(spec, mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _prune_spec(spec: P, mesh: Mesh) -> P:
+    def keep(axis):
+        return mesh.shape.get(axis, 1) > 1
+
+    pruned = []
+    for entry in spec:
+        if entry is None:
+            pruned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if keep(a))
+            pruned.append(kept if kept else None)
+        else:
+            pruned.append(entry if keep(entry) else None)
+    return P(*pruned)
+
+
+# ---------------------------------------------------------------------------
+# Batch sharding (the DistributedSampler/DataLoader device-side contract)
+# ---------------------------------------------------------------------------
+
+
+def batch_pspec(ndim: int = 1) -> P:
+    """PartitionSpec sharding axis 0 (batch) over the data-parallel axes."""
+    return P(BATCH_AXES, *([None] * (ndim - 1)))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec(ndim))
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Total data-parallel degree (replicas of the model across the batch)."""
+    return mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

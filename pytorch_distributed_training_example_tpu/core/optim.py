@@ -1,0 +1,54 @@
+"""Optimizer + LR schedule construction (optax chains).
+
+Reference parity: SGD-momentum with step/cosine decay for the vision configs,
+AdamW for ViT/GPT/Llama; warmup + cosine is the modern default for all five
+presets. Gradient clipping folds into the optax chain (the reference would
+call ``clip_grad_norm_`` between unscale and step).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from pytorch_distributed_training_example_tpu.utils.config import Config
+
+
+def build_schedule(cfg: Config, steps_per_epoch: int) -> optax.Schedule:
+    total_steps = max(int(cfg.epochs * steps_per_epoch), 1)
+    warmup_steps = min(int(cfg.warmup_epochs * steps_per_epoch), total_steps - 1)
+    if warmup_steps > 0:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.lr,
+            warmup_steps=warmup_steps, decay_steps=total_steps,
+        )
+    return optax.cosine_decay_schedule(cfg.lr, decay_steps=total_steps)
+
+
+def build_optimizer(cfg: Config, steps_per_epoch: int):
+    """Returns ``(tx, schedule)``; schedule is also used for logging lr."""
+    schedule = build_schedule(cfg, steps_per_epoch)
+    parts = []
+    if cfg.grad_clip and cfg.grad_clip > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip))
+    if cfg.optimizer == "sgd":
+        parts += [
+            optax.sgd(schedule, momentum=cfg.momentum, nesterov=True),
+        ]
+        if cfg.weight_decay:
+            # Decoupled WD on >=2D params only (skip BN/bias), torch-style.
+            parts.insert(-1, optax.add_decayed_weights(
+                cfg.weight_decay, mask=_wd_mask))
+    elif cfg.optimizer == "adamw":
+        parts.append(optax.adamw(
+            schedule, b1=0.9, b2=0.95 if "llama" in cfg.model or "gpt" in cfg.model else 0.999,
+            weight_decay=cfg.weight_decay, mask=_wd_mask,
+        ))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    return optax.chain(*parts), schedule
+
+
+def _wd_mask(params):
+    import jax
+
+    return jax.tree.map(lambda p: p.ndim >= 2, params)

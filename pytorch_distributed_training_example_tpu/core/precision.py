@@ -1,0 +1,128 @@
+"""Mixed-precision policy — the TPU-native replacement for AMP + GradScaler.
+
+Reference parity (SURVEY.md §2a #6, §2b N6): the reference wraps its forward
+pass in ``torch.cuda.amp.autocast`` and scales the loss with ``GradScaler``
+because fp16 has a narrow exponent range. TPUs compute natively in bfloat16,
+whose exponent range equals fp32, so the idiomatic policy is:
+
+    params fp32  /  compute bf16  /  no loss scaling
+
+expressed here as a :class:`Policy` that models consult for their ``dtype`` /
+``param_dtype``. A :class:`DynamicGradScaler` is still provided for exact API
+parity (``scale -> unscale -> check-finite -> step -> update``) and for fp16
+experiments; with the default bf16 policy it is simply never enabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """What dtype each class of tensor uses inside the compiled step."""
+
+    param_dtype: Any = jnp.float32   # master copy held in the train state
+    compute_dtype: Any = jnp.bfloat16  # matmul/conv inputs (MXU-native)
+    output_dtype: Any = jnp.float32  # logits / loss accumulation
+
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+#: Named presets selectable from the CLI (``--precision``).
+POLICIES: dict[str, Policy] = {
+    # Reference's fp32 baseline path (no autocast).
+    "fp32": Policy(jnp.float32, jnp.float32, jnp.float32),
+    # The TPU-native AMP equivalent: fp32 master params, bf16 compute.
+    "bf16": Policy(jnp.float32, jnp.bfloat16, jnp.float32),
+    # Fully bf16 (params too) — halves HBM for params; fine for inference
+    # and large-model training with care.
+    "pure_bf16": Policy(jnp.bfloat16, jnp.bfloat16, jnp.float32),
+    # fp16 with dynamic loss scaling — GPU-style AMP parity path.
+    "fp16": Policy(jnp.float32, jnp.float16, jnp.float32),
+}
+
+
+def get_policy(name: str | Policy) -> Policy:
+    if isinstance(name, Policy):
+        return name
+    try:
+        return POLICIES[name]
+    except KeyError:
+        raise ValueError(f"unknown precision policy {name!r}; have {sorted(POLICIES)}")
+
+
+def needs_loss_scaling(policy: Policy) -> bool:
+    return policy.compute_dtype == jnp.float16
+
+
+class ScalerState(struct.PyTreeNode):
+    """Functional ``GradScaler`` state (lives inside the jitted step).
+
+    Mirrors torch.cuda.amp.GradScaler semantics: multiply the loss by
+    ``scale`` before differentiation; if any grad is non-finite skip the
+    update and halve the scale; after ``growth_interval`` consecutive finite
+    steps double it.
+    """
+
+    scale: jax.Array
+    growth_tracker: jax.Array
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**15, **kw) -> "ScalerState":
+        return cls(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+            **kw,
+        )
+
+    def scale_loss(self, loss):
+        return loss * self.scale.astype(loss.dtype)
+
+    def unscale(self, grads):
+        inv = 1.0 / self.scale
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+
+    def update(self, grads_finite: jax.Array) -> "ScalerState":
+        tracker = jnp.where(grads_finite, self.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.scale * self.growth_factor, self.scale),
+            self.scale * self.backoff_factor,
+        )
+        return self.replace(
+            scale=jnp.clip(new_scale, 1.0, 2.0**24),
+            growth_tracker=jnp.where(grow, 0, tracker),
+        )
+
+
+def all_finite(tree) -> jax.Array:
+    leaves = [x for x in jax.tree.leaves(tree) if jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))

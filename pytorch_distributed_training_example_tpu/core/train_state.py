@@ -1,0 +1,51 @@
+"""Train state pytree: params + optimizer state + step, as one shardable value.
+
+Reference parity: the reference's mutable trio (``model`` module, ``optimizer``,
+``scaler``) becomes one immutable pytree threaded through a pure, jitted
+``train_step``. Sharding the state *is* the parallelism strategy; donating it
+to the step makes updates in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    apply_fn: Callable = struct.field(pytree_node=False)
+    rng: Any = None          # base PRNG key; per-step keys are fold_in(rng, step)
+    batch_stats: Any = None  # BatchNorm running stats (ResNet family); None otherwise
+    scaler: Any = None       # precision.ScalerState when fp16 loss-scaling is on
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, rng=None, batch_stats=None, scaler=None):
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+            batch_stats=batch_stats,
+            scaler=scaler,
+            tx=tx,
+            apply_fn=apply_fn,
+        )
+
+    def apply_gradients(self, grads, **updates) -> "TrainState":
+        upd, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, upd)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **updates,
+        )

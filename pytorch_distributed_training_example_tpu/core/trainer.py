@@ -1,0 +1,231 @@
+"""Epoch/step orchestration — the reference's ``train()``/``validate()`` loop.
+
+Reference call stack parity (SURVEY.md §3.2/§3.3): per-epoch
+``sampler.set_epoch`` -> per-step forward/backward/update -> periodic eval
+with cross-replica metric reduction -> rank-0 logging -> checkpoint. The
+host-side loop here never blocks on step results (async dispatch); metrics
+are fetched every ``log_every`` steps.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_example_tpu.core import (
+    checkpoint as checkpoint_lib,
+    distributed,
+    mesh as mesh_lib,
+    optim,
+    precision as precision_lib,
+    train_loop,
+)
+from pytorch_distributed_training_example_tpu.data import (
+    datasets as datasets_lib,
+    loader as loader_lib,
+    prefetch,
+    sampler as sampler_lib,
+)
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
+from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+from pytorch_distributed_training_example_tpu.utils.config import Config
+from pytorch_distributed_training_example_tpu.utils.logging import (
+    AverageMeter, MetricLogger, Throughput, log, setup_logging,
+)
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None):
+        self.cfg = cfg
+        self.metric_logger = setup_logging(
+            jsonl_path=os.path.join(cfg.checkpoint_dir, "metrics.jsonl")
+            if cfg.checkpoint_dir else None)
+
+        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(cfg.mesh_config())
+        self.policy = precision_lib.get_policy(cfg.precision)
+
+        self.bundle = registry.create_model(
+            cfg.model, num_classes=cfg.num_classes, image_size=cfg.image_size,
+            seq_len=cfg.seq_len, dtype=self.policy.compute_dtype,
+            param_dtype=self.policy.param_dtype, remat=cfg.remat)
+
+        # data ------------------------------------------------------------
+        self.train_data = datasets_lib.build_dataset(
+            cfg.dataset, cfg.data_path, train=True,
+            image_size=cfg.image_size, seq_len=cfg.seq_len, seed=cfg.seed)
+        self.eval_data = datasets_lib.build_dataset(
+            cfg.dataset, cfg.data_path, train=False,
+            image_size=cfg.image_size, seq_len=cfg.seq_len, seed=cfg.seed)
+        nproc = jax.process_count()
+        if cfg.global_batch_size % max(nproc, 1):
+            raise ValueError("global batch size must divide evenly across hosts")
+        dp = mesh_lib.dp_size(self.mesh)
+        if cfg.global_batch_size % dp:
+            raise ValueError(
+                f"--batch-size {cfg.global_batch_size} must be divisible by the "
+                f"data-parallel degree {dp} (mesh data x fsdp); e.g. use "
+                f"{(cfg.global_batch_size // dp + 1) * dp}")
+        self.local_batch = cfg.global_batch_size // nproc
+        self.train_loader = loader_lib.DataLoader(
+            self.train_data, self.local_batch,
+            sampler_lib.ShardedSampler(len(self.train_data), nproc,
+                                       jax.process_index(), shuffle=True,
+                                       seed=cfg.seed, drop_last=True),
+            num_workers=cfg.workers)
+        self.eval_loader = loader_lib.DataLoader(
+            self.eval_data, self.local_batch,
+            sampler_lib.ShardedSampler(len(self.eval_data), nproc,
+                                       jax.process_index(), shuffle=False),
+            num_workers=cfg.workers, drop_last=False)
+
+        self.steps_per_epoch = len(self.train_loader)
+        if cfg.steps_per_epoch:
+            self.steps_per_epoch = min(self.steps_per_epoch, cfg.steps_per_epoch)
+
+        # optimizer / state ------------------------------------------------
+        self.tx, self.schedule = optim.build_optimizer(cfg, self.steps_per_epoch)
+        scaler = (precision_lib.ScalerState.create()
+                  if precision_lib.needs_loss_scaling(self.policy) else None)
+        rules = sharding_lib.strategy_rules(cfg.strategy, self.bundle.rules)
+        self.state = train_loop.create_train_state(
+            self.bundle.module, self.tx, self.bundle.input_template,
+            self.mesh, rules, seed=cfg.seed, scaler=scaler)
+
+        task = train_loop.get_task(self.bundle.task, cfg.label_smoothing)
+        self.train_step = jax.jit(train_loop.make_train_step(task),
+                                  donate_argnums=0)
+        self.eval_step = jax.jit(train_loop.make_eval_step(task))
+        self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
+
+        # checkpointing ----------------------------------------------------
+        self.checkpointer = (checkpoint_lib.Checkpointer(cfg.checkpoint_dir)
+                             if cfg.checkpoint_dir else None)
+        self.start_epoch = 0
+        if cfg.resume and self.checkpointer:
+            self._resume()
+
+        self.profile_range = None
+        if cfg.profile_steps:
+            a, b = cfg.profile_steps.split(":")
+            self.profile_range = (int(a), int(b))
+
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state.params))
+        log.info("model=%s params=%.2fM devices=%d mesh=%s strategy=%s precision=%s",
+                 cfg.model, n_params / 1e6, jax.device_count(),
+                 dict(self.mesh.shape), cfg.strategy, cfg.precision)
+
+    # -- checkpoint glue ---------------------------------------------------
+
+    def _resume(self):
+        """``--resume`` accepts 'auto', a checkpoint root, or a step_NNN dir."""
+        import re
+
+        step = None
+        directory = self.checkpointer.directory
+        if self.cfg.resume not in ("auto", None):
+            target = self.cfg.resume.rstrip("/")
+            m = re.match(r"^step_(\d+)$", os.path.basename(target))
+            if m:  # specific step dir: resume exactly it
+                directory, step = os.path.dirname(target), int(m.group(1))
+            elif os.path.isdir(target):
+                directory = target
+            else:
+                raise FileNotFoundError(f"--resume path not found: {target}")
+            if directory != self.checkpointer.directory:
+                self.checkpointer = checkpoint_lib.Checkpointer(directory)
+        if step is None:
+            step = checkpoint_lib.latest_checkpoint(directory)
+            if step is None:
+                log.info("resume requested but no committed checkpoint in %s", directory)
+                return
+        self.state, extra = self.checkpointer.restore(self.state, step)
+        self.start_epoch = int(extra.get("epoch", -1)) + 1
+        log.info("resumed from step %d (epoch %d)", step, self.start_epoch)
+
+    def _save(self, epoch: int):
+        if self.checkpointer is None:
+            return
+        step = int(jax.device_get(self.state.step))
+        self.checkpointer.save(self.state, step, extra={"epoch": epoch})
+
+    # -- loops -------------------------------------------------------------
+
+    def train(self):
+        cfg = self.cfg
+        for epoch in range(self.start_epoch, cfg.epochs):
+            self.train_epoch(epoch)
+            if (epoch + 1) % cfg.eval_every_epochs == 0:
+                self.evaluate(epoch)
+            if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                self._save(epoch)
+        if self.checkpointer:
+            self.checkpointer.wait()
+        self.metric_logger.close()
+        return self.state
+
+    def train_epoch(self, epoch: int):
+        cfg = self.cfg
+        self.train_loader.set_epoch(epoch)
+        loss_m = AverageMeter("loss")
+        tput = Throughput()
+        t_step = time.perf_counter()
+        it = prefetch.device_prefetch(self.train_loader, self.batch_sharding)
+        with mesh_lib.use_mesh(self.mesh):
+            for i, batch in enumerate(it):
+                if i >= self.steps_per_epoch:
+                    break
+                gstep = epoch * self.steps_per_epoch + i
+                if self.profile_range and gstep == self.profile_range[0]:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                self.state, metrics = self.train_step(self.state, batch)
+                if self.profile_range and gstep + 1 == self.profile_range[1]:
+                    jax.tree.map(lambda x: x.block_until_ready(), metrics)
+                    jax.profiler.stop_trace()
+                    log.info("profile written to %s", cfg.profile_dir)
+                tput.update(cfg.global_batch_size)
+                if (i + 1) % cfg.log_every == 0 or i + 1 == self.steps_per_epoch:
+                    m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    loss_m.update(m["loss"])
+                    lr = float(self.schedule(gstep))
+                    dt = (time.perf_counter() - t_step) / cfg.log_every
+                    t_step = time.perf_counter()
+                    rate = tput.rate
+                    per_chip = rate / max(jax.device_count(), 1)
+                    mfu = metrics_lib.mfu(per_chip, self.bundle.fwd_flops_per_example)
+                    log.info(
+                        "epoch %d step %d/%d loss %.4f lr %.2e %s/s %.1f "
+                        "(%.1f/chip) mfu %.1f%% %s",
+                        epoch, i + 1, self.steps_per_epoch, m["loss"], lr,
+                        self.bundle.examples_unit, rate, per_chip, 100 * mfu,
+                        " ".join(f"{k} {v:.4f}" for k, v in m.items()
+                                 if k not in ("loss",)),
+                    )
+                    self.metric_logger.write(kind="train", epoch=epoch, step=gstep,
+                                             lr=lr, rate=rate, mfu=mfu, **m)
+
+    def evaluate(self, epoch: int):
+        sums: dict[str, float] = {}
+        n_batches = 0
+        padded = (prefetch.pad_batch(b, self.local_batch) for b in self.eval_loader)
+        with mesh_lib.use_mesh(self.mesh):
+            for batch in prefetch.device_prefetch(padded, self.batch_sharding):
+                stats = self.eval_step(self.state, batch)
+                m = {k: float(v) for k, v in jax.device_get(stats).items()}
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + v
+                n_batches += 1
+                if self.cfg.steps_per_epoch and n_batches >= self.cfg.steps_per_epoch:
+                    break
+        if n_batches:
+            count = max(sums.pop("count", 0.0), 1.0)
+            avg = {k.removesuffix("_sum"): v / count for k, v in sums.items()}
+            log.info("eval epoch %d %s (n=%d)", epoch,
+                     " ".join(f"{k} {v:.4f}" for k, v in avg.items()), int(count))
+            self.metric_logger.write(kind="eval", epoch=epoch, count=count, **avg)
+            return avg
+        return {}

@@ -1,0 +1,10 @@
+"""Input pipeline: sharded sampling, datasets, loading, and device prefetch.
+
+The reference's ``DistributedSampler``/``DataLoader`` pair (SURVEY.md §2a #3)
+maps to: per-host index sharding (:mod:`sampler`), a threaded loader
+(:mod:`loader`), and a double-buffered host->HBM prefetcher
+(:mod:`prefetch`) that assembles globally-sharded ``jax.Array`` batches.
+"""
+
+from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler  # noqa: F401
+from pytorch_distributed_training_example_tpu.data.loader import DataLoader  # noqa: F401

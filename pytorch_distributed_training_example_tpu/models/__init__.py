@@ -1,0 +1,7 @@
+"""Model zoo covering the reference's config matrix (BASELINE.json ``configs``):
+
+ResNet-18/50, ViT-B/16, GPT-2 124M, Llama-3 8B — built TPU-first (NHWC convs,
+bf16-friendly, static shapes, sharding-annotated activations).
+"""
+
+from pytorch_distributed_training_example_tpu.models.registry import create_model, list_models  # noqa: F401

@@ -1,0 +1,80 @@
+"""Model registry: name -> (module, task kind, input template, FLOPs, TP rules).
+
+The torchvision-factory equivalent (reference builds models via
+``torchvision.models.resnet50()`` etc., SURVEY.md §2a #4) plus the metadata
+the framework needs: which task head to use, an input template for sharded
+init, a forward-FLOPs estimate for MFU accounting, and per-family tensor-
+parallel rule tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    module: Any                      # flax module (constructed, not initialized)
+    task: str                        # "classification" | "lm"
+    input_template: tuple            # abstract sample inputs for init
+    fwd_flops_per_example: float     # forward FLOPs for one example (MFU accounting)
+    rules: dict[str, tuple]          # strategy name -> partition-rule table
+    examples_unit: str = "images"    # "images" | "sequences" (throughput label)
+
+
+_REGISTRY: dict[str, Callable[..., ModelBundle]] = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
+                 seq_len: int = 1024, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                 remat: bool = False) -> ModelBundle:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {list_models()}")
+    return _REGISTRY[name](
+        num_classes=num_classes, image_size=image_size, seq_len=seq_len,
+        dtype=dtype, param_dtype=param_dtype, remat=remat,
+    )
+
+
+@register("resnet18")
+def _resnet18(*, num_classes, image_size, dtype, param_dtype, **_):
+    from pytorch_distributed_training_example_tpu.models import resnet
+
+    module = resnet.resnet18(num_classes=num_classes, dtype=dtype,
+                             param_dtype=param_dtype,
+                             small_images=image_size <= 64)
+    return ModelBundle(
+        module=module, task="classification",
+        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
+        fwd_flops_per_example=resnet.flops_per_image("resnet18", image_size),
+        rules={},
+    )
+
+
+@register("resnet50")
+def _resnet50(*, num_classes, image_size, dtype, param_dtype, **_):
+    from pytorch_distributed_training_example_tpu.models import resnet
+
+    module = resnet.resnet50(num_classes=num_classes, dtype=dtype,
+                             param_dtype=param_dtype,
+                             small_images=image_size <= 64)
+    return ModelBundle(
+        module=module, task="classification",
+        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
+        fwd_flops_per_example=resnet.flops_per_image("resnet50", image_size),
+        rules={},
+    )

@@ -1,0 +1,137 @@
+"""ResNet-18/50 — the reference's vision workloads (BASELINE.json configs 1-2).
+
+TPU-first choices (vs the reference's ``torchvision.models.resnet``):
+
+- NHWC layout: XLA:TPU's native conv layout (torchvision is NCHW).
+- BatchNorm over a GSPMD-sharded batch axis reduces over the *global* batch
+  (SyncBN semantics for free — inside the single compiled step, no extra
+  collective pass like GPU SyncBN needs).
+- dtype/param_dtype plumbed from the precision Policy (AMP equivalent).
+- ``strides=2`` conv layers padded SAME to keep shapes powers-of-two-ish for
+  MXU tiling.
+
+The classic architecture: stem (7x7/2 conv + 3x3/2 maxpool), 4 stages of
+residual blocks ([2,2,2,2] BasicBlock for -18; [3,4,6,3] Bottleneck for -50),
+global average pool, linear head.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_norm")(residual)
+        return self.act(residual + y)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_norm")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32        # compute dtype (Policy.compute_dtype)
+    param_dtype: Any = jnp.float32
+    small_images: bool = False      # CIFAR stem: 3x3/1 conv, no maxpool
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, padding="SAME",
+                       dtype=self.dtype, param_dtype=self.param_dtype,
+                       kernel_init=nn.initializers.he_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5,
+                       dtype=self.dtype, param_dtype=self.param_dtype)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.small_images:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2**i, strides, conv, norm, act)(x)
+            x = mesh_lib.constrain(x, P(mesh_lib.BATCH_AXES, None, None, None))
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock,
+                  num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck,
+                  num_classes=num_classes, **kw)
+
+
+def flops_per_image(name: str, image_size: int = 224) -> float:
+    """Approximate forward FLOPs per image (for MFU accounting).
+
+    Standard published figures: ResNet-50 @224 ~= 4.09 GFLOP (multiply-adds
+    x2), ResNet-18 @224 ~= 1.81 GFLOP; scaled quadratically for other sizes.
+    """
+    base = {"resnet18": 1.81e9, "resnet50": 4.09e9}[name]
+    return base * (image_size / 224.0) ** 2

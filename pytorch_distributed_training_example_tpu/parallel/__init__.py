@@ -1,0 +1,1 @@
+"""Parallelism strategies expressed as sharding rules over the named mesh (SURVEY.md §2c)."""

@@ -1,0 +1,141 @@
+"""Parallelism as data: parameter partition rules over the named mesh.
+
+Reference parity (SURVEY.md §2c): the reference's only strategy object is the
+``DistributedDataParallel`` wrapper (replicate params, all-reduce grads); its
+config matrix additionally names FSDP and gradient checkpointing. Here every
+strategy — DP, FSDP/ZeRO-3, TP, and their compositions — is a *table of
+rules* mapping parameter path patterns to :class:`PartitionSpec`s. Changing
+strategy changes the table, not the model or the train step: XLA's GSPMD
+partitioner reads the resulting ``NamedSharding``s and inserts the
+all-gathers / reduce-scatters / psums that DDP's C++ reducer and FSDP's
+wrapper perform by hand on GPU.
+
+Rule syntax: ``(regex, PartitionSpec)`` matched (``re.search``) against the
+``'/'``-joined parameter path, first match wins. The special sentinel
+:data:`AUTO_FSDP` shards the largest divisible dimension along the ``fsdp``
+axis — the generic ZeRO-3 fallback that needs no per-model table.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+#: Sentinel: shard the largest dim divisible by the fsdp axis size.
+AUTO_FSDP = "AUTO_FSDP"
+
+Rule = tuple[str, Any]  # (path regex, PartitionSpec | AUTO_FSDP)
+
+
+def param_path(keypath) -> str:
+    """Render a jax tree key-path as 'a/b/c'."""
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+#: Params smaller than this many elements stay replicated under AUTO_FSDP
+#: (norm scales, biases): sharding tiny tensors costs more in collective
+#: latency than it saves in HBM — torch FSDP's min-wrap-size analog.
+MIN_SHARD_ELEMENTS = 16384
+
+
+def _auto_fsdp_spec(shape: Sequence[int], fsdp_size: int, extra: P | None = None) -> P:
+    """Shard the largest dimension divisible by ``fsdp_size``; replicate if none.
+
+    ``extra`` (a PartitionSpec of same rank, e.g. a TP spec) marks dims that
+    are already taken; the fsdp axis composes with it on a free dim.
+    """
+    if fsdp_size <= 1 or (math.prod(shape) < MIN_SHARD_ELEMENTS if shape else True):
+        return extra if extra is not None else P()
+    taken = list(extra) if extra is not None else [None] * len(shape)
+    taken += [None] * (len(shape) - len(taken))
+    best, best_dim = -1, None
+    for d, s in enumerate(shape):
+        if taken[d] is None and s % fsdp_size == 0 and s > best:
+            best, best_dim = s, d
+    if best_dim is None:
+        return P(*taken) if extra is not None else P()
+    taken[best_dim] = "fsdp"
+    return P(*taken)
+
+
+def spec_for(path: str, shape: Sequence[int], rules: Sequence[Rule], mesh: Mesh) -> P:
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if isinstance(spec, str) and spec == AUTO_FSDP:
+                return _auto_fsdp_spec(shape, fsdp_size)
+            # Compose explicit (e.g. TP) specs with auto-fsdp on a free dim.
+            spec = mesh_lib._prune_spec(spec, mesh)
+            return _auto_fsdp_spec(shape, fsdp_size, extra=spec) if fsdp_size > 1 else spec
+    return _auto_fsdp_spec(shape, fsdp_size)
+
+
+def infer_specs(params, rules: Sequence[Rule], mesh: Mesh):
+    """Pytree of PartitionSpec matching ``params``' structure."""
+
+    def one(keypath, x):
+        shape = np.shape(x)
+        return spec_for(param_path(keypath), shape, rules, mesh)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def make_shardings(params_or_specs, mesh: Mesh, rules: Sequence[Rule] = ()):
+    """Pytree of NamedSharding for ``params`` (or an already-inferred spec tree)."""
+    leaves = jax.tree.leaves(params_or_specs)
+    if leaves and isinstance(leaves[0], P):
+        specs = params_or_specs
+    else:
+        specs = infer_specs(params_or_specs, rules, mesh)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, mesh: Mesh, rules: Sequence[Rule] = ()):
+    """Place (or re-place) a param pytree according to the rules."""
+    shardings = make_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Strategy tables
+# ---------------------------------------------------------------------------
+
+#: Pure DP — replicate everything (the reference's DDP semantics).
+DP_RULES: tuple[Rule, ...] = ((".*", P()),)
+
+#: ZeRO-3 / FSDP — shard every param's largest divisible dim on 'fsdp'.
+FSDP_RULES: tuple[Rule, ...] = ((".*", AUTO_FSDP),)
+
+
+def strategy_rules(strategy: str, model_rules: dict[str, Sequence[Rule]] | None = None):
+    """Resolve a strategy name to its rule table.
+
+    ``model_rules`` lets a model family contribute TP tables (e.g. Megatron
+    column/row splits for attention and MLP); generic strategies need none.
+    """
+    model_rules = model_rules or {}
+    if strategy in model_rules:
+        return tuple(model_rules[strategy])
+    if strategy in ("dp", "ddp", "none"):
+        return DP_RULES
+    if strategy in ("fsdp", "zero3"):
+        return FSDP_RULES
+    raise ValueError(f"unknown strategy {strategy!r} (model provides {sorted(model_rules)})")

@@ -1,0 +1,1 @@
+"""Config, logging/metrics, profiling, and guard-rail utilities."""

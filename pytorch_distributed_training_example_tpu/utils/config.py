@@ -1,0 +1,109 @@
+"""Flat dataclass config + the five workload presets from BASELINE.json.
+
+Reference parity (SURVEY.md §5 config): the reference's config system is
+argparse flags on ``main.py``. We keep that CLI surface (main.py builds one
+of these dataclasses from flags) backed by named presets matching the
+reference's config matrix exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class Config:
+    # workload
+    model: str = "resnet18"
+    dataset: str = "cifar10"
+    num_classes: int = 10
+    image_size: int = 32
+    seq_len: int = 1024
+    # optimization
+    epochs: int = 10
+    global_batch_size: int = 256
+    lr: float = 0.1
+    warmup_epochs: float = 1.0
+    weight_decay: float = 1e-4
+    momentum: float = 0.9
+    optimizer: str = "sgd"  # sgd | adamw
+    label_smoothing: float = 0.0
+    grad_clip: float = 0.0
+    # precision / memory
+    precision: str = "bf16"
+    remat: bool = False  # gradient checkpointing (reference configs[4])
+    # parallelism (mesh axis sizes; -1 absorbs remaining devices)
+    strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)
+    mesh_data: int = -1
+    mesh_fsdp: int = 1
+    mesh_stage: int = 1
+    mesh_expert: int = 1
+    mesh_context: int = 1
+    mesh_model: int = 1
+    # io
+    data_path: str | None = None
+    workers: int = 4
+    log_every: int = 50
+    eval_every_epochs: int = 1
+    checkpoint_dir: str | None = None
+    checkpoint_every_epochs: int = 1
+    resume: str | None = None  # path | "auto"
+    seed: int = 0
+    # profiling
+    profile_steps: str | None = None  # "start:stop" step range
+    profile_dir: str = "/tmp/pdtx_profile"
+    # loop control (bench/smoke)
+    steps_per_epoch: int | None = None  # cap steps (synthetic/bench runs)
+
+    def mesh_config(self) -> dict[str, int]:
+        return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,
+                    expert=self.mesh_expert, context=self.mesh_context,
+                    model=self.mesh_model)
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+#: The reference's workload matrix (BASELINE.json ``configs``), one preset each.
+PRESETS: dict[str, dict[str, Any]] = {
+    # configs[0]: ResNet-18 / CIFAR-10 — single-process, CPU-runnable dev config
+    "resnet18_cifar10": dict(
+        model="resnet18", dataset="cifar10", num_classes=10, image_size=32,
+        epochs=30, global_batch_size=256, lr=0.1, warmup_epochs=2.0,
+        weight_decay=5e-4, precision="fp32", strategy="dp",
+    ),
+    # configs[1]: ResNet-50 / ImageNet-1k — data-parallel (the driver metric)
+    "resnet50_imagenet": dict(
+        model="resnet50", dataset="imagenet", num_classes=1000, image_size=224,
+        epochs=90, global_batch_size=1024, lr=0.4, warmup_epochs=5.0,
+        weight_decay=1e-4, precision="bf16", strategy="dp",
+    ),
+    # configs[2]: ViT-B/16 / ImageNet-1k — DDP -> pjit data-parallel
+    "vit_b16_imagenet": dict(
+        model="vit_b16", dataset="imagenet", num_classes=1000, image_size=224,
+        epochs=90, global_batch_size=1024, lr=3e-3, warmup_epochs=10.0,
+        weight_decay=0.1, optimizer="adamw", label_smoothing=0.1,
+        precision="bf16", strategy="dp", grad_clip=1.0,
+    ),
+    # configs[3]: GPT-2 124M LM — FSDP -> GSPMD param-shard
+    "gpt2_124m": dict(
+        model="gpt2", dataset="lm", seq_len=1024, epochs=1,
+        global_batch_size=256, lr=6e-4, warmup_epochs=0.01,
+        weight_decay=0.1, optimizer="adamw", precision="bf16",
+        strategy="fsdp", mesh_data=1, mesh_fsdp=-1, grad_clip=1.0,
+    ),
+    # configs[4]: Llama-3 8B — FSDP + gradient checkpointing
+    "llama3_8b": dict(
+        model="llama3_8b", dataset="lm", seq_len=8192, epochs=1,
+        global_batch_size=128, lr=3e-4, warmup_epochs=0.01,
+        weight_decay=0.1, optimizer="adamw", precision="bf16",
+        strategy="fsdp", mesh_data=1, mesh_fsdp=-1, remat=True, grad_clip=1.0,
+    ),
+}
+
+
+def from_preset(name: str, **overrides) -> Config:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return Config(**{**PRESETS[name], **overrides})

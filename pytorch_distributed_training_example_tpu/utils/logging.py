@@ -1,0 +1,93 @@
+"""Process-0 structured logging (console + JSONL) and the AverageMeter.
+
+Reference parity (SURVEY.md §5 metrics): the reference prints loss/acc/
+images-per-sec from rank 0 using the classic ``AverageMeter`` pattern. Same
+surface here, plus machine-readable JSONL for the bench harness.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+import jax
+
+log = logging.getLogger("pdtx")
+
+
+def setup_logging(level: int = logging.INFO, jsonl_path: str | None = None) -> "MetricLogger":
+    """Configure stdout logging on process 0 (other processes stay quiet)."""
+    is_main = jax.process_index() == 0
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(message)s",
+                                           datefmt="%H:%M:%S"))
+    log.handlers[:] = [handler]
+    log.setLevel(level if is_main else logging.ERROR)
+    log.propagate = False
+    return MetricLogger(jsonl_path if is_main else None)
+
+
+class MetricLogger:
+    def __init__(self, jsonl_path: str | None = None):
+        self._fh = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._fh = open(jsonl_path, "a")
+
+    def write(self, **metrics):
+        if self._fh is not None:
+            metrics.setdefault("time", time.time())
+            self._fh.write(json.dumps(metrics, default=float) + "\n")
+            self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class AverageMeter:
+    """Running average of a scalar (the reference's logging idiom)."""
+
+    def __init__(self, name: str = "", fmt: str = ":.4f"):
+        self.name, self.fmt = name, fmt
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = self.avg = 0.0
+
+    def update(self, val: float, n: int = 1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {format(self.val, self.fmt[1:])} ({format(self.avg, self.fmt[1:])})"
+
+
+class Throughput:
+    """Images|tokens-per-second meter with warmup skip."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._n = 0
+        self._items = 0
+        self._t0 = None
+
+    def update(self, items: int):
+        self._n += 1
+        if self._n == self.warmup_steps:
+            self._t0 = time.perf_counter()
+            self._items = 0
+        elif self._n > self.warmup_steps:
+            self._items += items
+
+    @property
+    def rate(self) -> float:
+        if self._t0 is None or self._items == 0:
+            return 0.0
+        return self._items / (time.perf_counter() - self._t0)

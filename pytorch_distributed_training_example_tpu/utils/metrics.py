@@ -1,0 +1,95 @@
+"""In-step metrics (loss/accuracy) and MFU accounting.
+
+Metric reduction happens *inside* the compiled step over the sharded batch
+(reference: ``dist.all_reduce(metric_sum)`` after the fact, SURVEY.md §3.3) —
+with GSPMD, ``jnp.sum`` over a batch-sharded array already is the global
+reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits, labels, label_smoothing: float = 0.0):
+    """Mean softmax CE over the (possibly sharded) batch, fp32 accumulation."""
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    if label_smoothing > 0.0:
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing
+        )
+        losses = optax.softmax_cross_entropy(logits, onehot)
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return losses.mean()
+
+
+def per_example_cross_entropy(logits, labels):
+    """Unreduced CE per example/token (fp32)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels)
+
+
+def topk_correct(logits, labels, ks=(1, 5), mask=None):
+    """Count of top-k correct predictions (summed over the global batch).
+
+    ``mask`` (float [batch]) zeroes out padded examples in the final eval
+    batch (the DistributedSampler wrap-around analog).
+    """
+    out = {}
+    maxk = max(ks)
+    maxk = min(maxk, logits.shape[-1])
+    _, pred = jax.lax.top_k(logits, maxk)
+    hit = pred == labels[..., None]
+    for k in ks:
+        correct = hit[..., : min(k, maxk)].any(-1)
+        if mask is not None:
+            out[f"top{k}"] = jnp.sum(correct.astype(jnp.float32) * mask)
+        else:
+            out[f"top{k}"] = jnp.sum(correct)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MFU — the driver metric (BASELINE.json): achieved FLOP/s vs peak.
+# ---------------------------------------------------------------------------
+
+#: Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,  # v5e
+    "tpu v5": 459e12,       # v5p
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,  # trillium
+    "cpu": 1e12,            # nominal; CPU MFU is not meaningful
+}
+
+
+def peak_flops_per_chip(device=None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def training_flops_per_example(fwd_flops: float) -> float:
+    """fwd + bwd ~= 3x forward (bwd is 2x: grads wrt activations and params)."""
+    return 3.0 * fwd_flops
+
+
+def mfu(examples_per_sec_per_chip: float, fwd_flops_per_example: float,
+        device=None) -> float:
+    achieved = examples_per_sec_per_chip * training_flops_per_example(fwd_flops_per_example)
+    return achieved / peak_flops_per_chip(device)
+
+
+def transformer_flops_per_token(n_params: int, seq_len: int, n_layers: int,
+                                d_model: int) -> float:
+    """Forward FLOPs/token: 2*N plus attention's 2*2*L*s*d (PaLM appendix-B style)."""
+    return 2.0 * n_params + 4.0 * n_layers * seq_len * d_model

@@ -1,0 +1,28 @@
+"""Test harness: 8 fake CPU devices in one process (SURVEY.md §4.2).
+
+Env must be set before jax initializes its backends; pytest imports conftest
+before any test module, so doing it at module import time is safe. The axon
+sitecustomize exports JAX_PLATFORMS=axon — override it to keep CI off the
+real chip.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,76 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.core import (
+    checkpoint as ckpt_lib, mesh as mesh_lib, optim, train_loop)
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
+from pytorch_distributed_training_example_tpu.utils.config import Config
+
+
+def _state(mesh, strategy="dp", seed=0):
+    bundle = registry.create_model("resnet18", num_classes=10, image_size=32,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    tx, _ = optim.build_optimizer(Config(), steps_per_epoch=10)
+    rules = sharding_lib.strategy_rules(strategy, bundle.rules)
+    return train_loop.create_train_state(bundle.module, tx,
+                                         bundle.input_template, mesh, rules,
+                                         seed=seed)
+
+
+def _assert_state_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(a.opt_state), jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_same_sharding(tmp_path, devices):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    state = _state(mesh)
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    ck.save(state, 7, extra={"epoch": 3}, block=True)
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 7
+    other = _state(mesh, seed=99)  # different init; restore must overwrite
+    restored, extra = ck.restore(other)
+    assert extra == {"epoch": 3}
+    _assert_state_equal(state, restored)
+
+
+def test_restore_across_shardings(tmp_path, devices):
+    """Save under FSDP, restore under DP (topology/strategy change on resume)."""
+    fsdp_mesh = mesh_lib.build_mesh({"data": 2, "fsdp": 4})
+    state = _state(fsdp_mesh, "fsdp")
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    ck.save(state, 1, block=True)
+
+    dp_mesh = mesh_lib.build_mesh({"data": 8})
+    template = _state(dp_mesh, "dp", seed=5)
+    restored, _ = ck.restore(template)
+    _assert_state_equal(state, restored)
+    # restored leaves carry the *template* (DP) shardings
+    for p in jax.tree.leaves(restored.params):
+        assert p.sharding.is_fully_replicated
+
+
+def test_uncommitted_checkpoint_ignored(tmp_path, devices):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    state = _state(mesh)
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    ck.save(state, 1, block=True)
+    ck.save(state, 2, block=True)
+    os.remove(os.path.join(str(tmp_path), "step_00000002", ckpt_lib.COMMIT_FILE))
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 1
+
+
+def test_prune_keeps_newest(tmp_path, devices):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    state = _state(mesh)
+    ck = ckpt_lib.Checkpointer(str(tmp_path), keep=2)
+    for s in (1, 2, 3, 4):
+        ck.save(state, s, block=True)
+    assert ckpt_lib.all_checkpoints(str(tmp_path)) == [3, 4]

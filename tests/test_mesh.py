@@ -1,0 +1,48 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+
+def test_axes_and_default_mesh(devices):
+    m = mesh_lib.build_mesh()
+    assert m.axis_names == mesh_lib.AXES
+    assert m.shape["data"] == 8
+    assert all(m.shape[a] == 1 for a in mesh_lib.AXES if a != "data")
+
+
+def test_wildcard_resolution(devices):
+    m = mesh_lib.build_mesh({"data": -1, "fsdp": 2, "model": 2})
+    assert m.shape["data"] == 2 and m.shape["fsdp"] == 2 and m.shape["model"] == 2
+
+
+def test_bad_shapes(devices):
+    with pytest.raises(ValueError):
+        mesh_lib.build_mesh({"data": 3})  # 8 not divisible by 3
+    with pytest.raises(ValueError):
+        mesh_lib.MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+def test_batch_sharding_covers_devices(devices):
+    m = mesh_lib.build_mesh({"data": 4, "fsdp": 2})
+    assert mesh_lib.dp_size(m) == 8
+    sh = mesh_lib.batch_sharding(m, ndim=2)
+    x = jax.device_put(np.arange(16 * 3).reshape(16, 3).astype(np.float32), sh)
+    assert len(x.addressable_shards) == 8
+    assert all(s.data.shape == (2, 3) for s in x.addressable_shards)
+
+
+def test_constrain_prunes_missing_axes(devices):
+    m = mesh_lib.build_mesh({"data": 8})  # model axis size 1
+    with mesh_lib.use_mesh(m):
+        x = jax.numpy.zeros((8, 4))
+        y = mesh_lib.constrain(x, P(("data", "fsdp"), "model"))
+        assert y.shape == x.shape
+    assert mesh_lib.current_mesh() is None
+
+
+def test_single_device_mesh(devices):
+    m = mesh_lib.single_device_mesh()
+    assert mesh_lib.dp_size(m) == 1

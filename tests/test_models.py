@@ -1,0 +1,47 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.models import registry
+
+
+@pytest.mark.parametrize("name,size,classes", [
+    ("resnet18", 32, 10),
+    ("resnet50", 64, 100),
+])
+def test_resnet_forward_shapes(name, size, classes):
+    bundle = registry.create_model(name, num_classes=classes, image_size=size,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    x = jnp.zeros((4, size, size, 3))
+    variables = bundle.module.init(jax.random.PRNGKey(0), x, train=False)
+    logits = bundle.module.apply(variables, x, train=False)
+    assert logits.shape == (4, classes)
+    assert logits.dtype == jnp.float32
+    # train mode mutates batch_stats
+    logits2, mutated = bundle.module.apply(
+        variables, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(1)})
+    assert "batch_stats" in mutated
+
+
+def test_param_count_resnet18():
+    bundle = registry.create_model("resnet18", num_classes=1000, image_size=224,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    variables = jax.eval_shape(
+        lambda: bundle.module.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 224, 224, 3)), train=False))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    # torchvision resnet18 has 11.69M params
+    assert 11.4e6 < n < 12.0e6, n
+
+
+def test_bf16_compute_fp32_params():
+    bundle = registry.create_model("resnet18", num_classes=10, image_size=32,
+                                   dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = bundle.module.init(jax.random.PRNGKey(0), x, train=False)
+    for p in jax.tree.leaves(variables["params"]):
+        assert p.dtype == jnp.float32
+    logits = bundle.module.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32  # outputs cast back up

@@ -1,0 +1,53 @@
+"""DistributedSampler-equivalence properties (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
+
+
+@pytest.mark.parametrize("n,shards", [(100, 4), (101, 4), (8, 8), (1000, 7)])
+def test_full_coverage_once_per_epoch(n, shards):
+    seen = []
+    lengths = set()
+    for r in range(shards):
+        s = ShardedSampler(n, shards, r, shuffle=True, seed=3)
+        idx = s.local_indices()
+        lengths.add(len(idx))
+        seen.append(idx)
+    assert len(lengths) == 1  # equal steps per shard
+    allidx = np.concatenate(seen)
+    # padded by wrap-around: every example appears at least once, at most twice
+    counts = np.bincount(allidx, minlength=n)
+    assert counts.min() >= 1
+    assert (counts >= 1).all() and counts.sum() == len(allidx)
+    extra = len(allidx) - n
+    assert (counts == 2).sum() == extra
+
+
+def test_drop_last():
+    total = 0
+    for r in range(4):
+        s = ShardedSampler(103, 4, r, shuffle=False, drop_last=True)
+        total += len(s.local_indices())
+    assert total == 100  # 103 -> 25 per shard
+
+
+def test_epoch_reshuffle_and_determinism():
+    a = ShardedSampler(50, 2, 0, seed=7)
+    b = ShardedSampler(50, 2, 0, seed=7)
+    assert (a.local_indices() == b.local_indices()).all()
+    a.set_epoch(1)
+    assert not (a.local_indices() == b.local_indices()).all()
+    b.set_epoch(1)
+    assert (a.local_indices() == b.local_indices()).all()
+
+
+def test_no_shuffle_is_strided():
+    s = ShardedSampler(10, 2, 1, shuffle=False)
+    assert s.local_indices().tolist() == [1, 3, 5, 7, 9]
+
+
+def test_shard_id_validation():
+    with pytest.raises(ValueError):
+        ShardedSampler(10, 2, 2)
