@@ -1,0 +1,215 @@
+// Native batch-assembly engine for the input pipeline.
+//
+// Reference parity (SURVEY.md §2b N7): torch's DataLoader escapes the GIL by
+// forking worker *processes* and paying pickle/shared-memory costs per batch.
+// This engine keeps one process and escapes the GIL the native way: batch
+// assembly (index gather + augmentation + normalization) runs on C++ threads
+// over memory-resident datasets, writing directly into caller-owned output
+// buffers (which Python hands to jax.device_put — the host->HBM copy then
+// overlaps compute via async dispatch).
+//
+// Two dataset modes:
+//   - image mode: uint8 [N,H,W,C] source; per-sample ops are reflect-pad-4 +
+//     random crop + horizontal flip (CIFAR recipe) and mean/std normalize to
+//     float32 NHWC.
+//   - gather mode: raw row gather of fixed-size samples (token sequences,
+//     pre-processed float images) with no transform.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libbatch_engine.so batch_engine.cc -lpthread
+// Driven from Python via ctypes (data/native_loader.py). Plain C ABI.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Job {
+  int64_t batch_id;
+  std::vector<int64_t> indices;
+  void* out;            // caller-owned output buffer
+  uint64_t seed;        // per-batch RNG seed (epoch-stable determinism)
+};
+
+// splitmix64: tiny deterministic per-sample RNG
+static inline uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Engine {
+  // dataset description
+  const uint8_t* u8_data = nullptr;    // image mode
+  const uint8_t* raw_data = nullptr;   // gather mode
+  int64_t n = 0, height = 0, width = 0, channels = 0;
+  int64_t sample_bytes = 0;            // gather mode row size
+  float mean[8] = {0}, stdinv[8] = {1, 1, 1, 1, 1, 1, 1, 1};
+  bool augment = false;
+  int pad = 4;
+
+  // worker pool
+  std::vector<std::thread> workers;
+  std::deque<Job> queue;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop{false};
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  std::vector<int64_t> done_ids;
+
+  void worker_loop() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return stop.load() || !queue.empty(); });
+        if (stop.load() && queue.empty()) return;
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      run(job);
+      {
+        std::lock_guard<std::mutex> lk(done_mu);
+        done_ids.push_back(job.batch_id);
+      }
+      done_cv.notify_all();
+    }
+  }
+
+  void run(const Job& job) {
+    if (u8_data) run_image(job);
+    else run_gather(job);
+  }
+
+  void run_gather(const Job& job) {
+    uint8_t* out = static_cast<uint8_t*>(job.out);
+    for (size_t i = 0; i < job.indices.size(); ++i) {
+      std::memcpy(out + i * sample_bytes,
+                  raw_data + job.indices[i] * sample_bytes,
+                  static_cast<size_t>(sample_bytes));
+    }
+  }
+
+  void run_image(const Job& job) {
+    const int64_t H = height, W = width, C = channels;
+    float* out = static_cast<float*>(job.out);
+    const int64_t hw = H * W * C;
+    for (size_t i = 0; i < job.indices.size(); ++i) {
+      const uint8_t* src = u8_data + job.indices[i] * hw;
+      float* dst = out + i * hw;
+      int dy = 0, dx = 0;
+      bool flip = false;
+      if (augment) {
+        uint64_t r = mix(job.seed ^ (0x517cc1b7ULL * (i + 1)));
+        dy = static_cast<int>(r % (2 * pad + 1)) - pad;
+        dx = static_cast<int>((r >> 16) % (2 * pad + 1)) - pad;
+        flip = ((r >> 32) & 1) != 0;
+      }
+      for (int64_t y = 0; y < H; ++y) {
+        // reflect-pad source row index
+        int64_t sy = y + dy;
+        if (sy < 0) sy = -sy;
+        if (sy >= H) sy = 2 * H - 2 - sy;
+        for (int64_t x = 0; x < W; ++x) {
+          int64_t sx = x + dx;
+          if (sx < 0) sx = -sx;
+          if (sx >= W) sx = 2 * W - 2 - sx;
+          if (flip) sx = W - 1 - sx;
+          const uint8_t* px = src + (sy * W + sx) * C;
+          float* q = dst + (y * W + x) * C;
+          for (int64_t c = 0; c < C; ++c) {
+            q[c] = (static_cast<float>(px[c]) * (1.0f / 255.0f) - mean[c]) *
+                   stdinv[c];
+          }
+        }
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* be_create_image(const uint8_t* data, int64_t n, int64_t h, int64_t w,
+                      int64_t c, const float* mean, const float* std_,
+                      int augment, int num_threads) {
+  Engine* e = new Engine();
+  e->u8_data = data;
+  e->n = n;
+  e->height = h;
+  e->width = w;
+  e->channels = c;
+  for (int64_t i = 0; i < c && i < 8; ++i) {
+    e->mean[i] = mean[i];
+    e->stdinv[i] = 1.0f / std_[i];
+  }
+  e->augment = augment != 0;
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    e->workers.emplace_back([e] { e->worker_loop(); });
+  return e;
+}
+
+void* be_create_gather(const uint8_t* data, int64_t n, int64_t sample_bytes,
+                       int num_threads) {
+  Engine* e = new Engine();
+  e->raw_data = data;
+  e->n = n;
+  e->sample_bytes = sample_bytes;
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    e->workers.emplace_back([e] { e->worker_loop(); });
+  return e;
+}
+
+// Submit one batch: gather `count` samples by `indices` into `out`.
+void be_submit(void* handle, int64_t batch_id, const int64_t* indices,
+               int64_t count, void* out, uint64_t seed) {
+  Engine* e = static_cast<Engine*>(handle);
+  Job job;
+  job.batch_id = batch_id;
+  job.indices.assign(indices, indices + count);
+  job.out = out;
+  job.seed = seed;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    e->queue.push_back(std::move(job));
+  }
+  e->cv.notify_one();
+}
+
+// Block until `batch_id` has been produced, then retire the id (so ids may
+// be reused and done_ids stays bounded). Returns 0 on success, 1 on timeout.
+int be_wait(void* handle, int64_t batch_id, int64_t timeout_ms) {
+  Engine* e = static_cast<Engine*>(handle);
+  auto find = [&] {
+    for (size_t i = 0; i < e->done_ids.size(); ++i)
+      if (e->done_ids[i] == batch_id) return static_cast<int64_t>(i);
+    return static_cast<int64_t>(-1);
+  };
+  std::unique_lock<std::mutex> lk(e->done_mu);
+  bool ok = e->done_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                [&] { return find() >= 0; });
+  if (!ok) return 1;
+  e->done_ids.erase(e->done_ids.begin() + find());
+  return 0;
+}
+
+void be_destroy(void* handle) {
+  Engine* e = static_cast<Engine*>(handle);
+  e->stop.store(true);
+  e->cv.notify_all();
+  for (auto& t : e->workers) t.join();
+  delete e;
+}
+
+}  // extern "C"

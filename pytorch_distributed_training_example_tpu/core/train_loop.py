@@ -163,16 +163,19 @@ def make_train_step(task) -> Callable:
 
         def loss_fn(params):
             variables = {"params": params}
-            mutable = []
+            # "losses" collects model-internal auxiliary terms (MoE load
+            # balancing); "batch_stats" is BatchNorm's running stats.
+            mutable = ["losses"]
             if state.batch_stats is not None:
                 variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
+                mutable.append("batch_stats")
             inputs = [batch[k] for k in task.inputs]
-            out = state.apply_fn(variables, *inputs, train=True,
-                                 rngs={"dropout": step_rng},
-                                 mutable=mutable)
-            logits, new_vars = out if mutable else (out, {})
+            logits, new_vars = state.apply_fn(
+                variables, *inputs, train=True,
+                rngs={"dropout": step_rng}, mutable=mutable)
             loss = task.loss(logits, batch)
+            for aux in jax.tree.leaves(new_vars.get("losses", {})):
+                loss = loss + aux
             scaled = state.scaler.scale_loss(loss) if state.scaler is not None else loss
             return scaled, (loss, logits, new_vars.get("batch_stats"))
 

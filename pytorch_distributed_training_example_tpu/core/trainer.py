@@ -33,6 +33,7 @@ from pytorch_distributed_training_example_tpu.data import (
 from pytorch_distributed_training_example_tpu.models import registry
 from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
 from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+from pytorch_distributed_training_example_tpu.utils import watchdog as watchdog_lib
 from pytorch_distributed_training_example_tpu.utils.config import Config
 from pytorch_distributed_training_example_tpu.utils.logging import (
     AverageMeter, MetricLogger, Throughput, log, setup_logging,
@@ -55,12 +56,13 @@ class Trainer:
             param_dtype=self.policy.param_dtype, remat=cfg.remat)
 
         # data ------------------------------------------------------------
+        vocab = getattr(self.bundle.module, "vocab_size", 50257)
+        data_kw = dict(image_size=cfg.image_size, seq_len=cfg.seq_len,
+                       seed=cfg.seed, vocab_size=vocab)
         self.train_data = datasets_lib.build_dataset(
-            cfg.dataset, cfg.data_path, train=True,
-            image_size=cfg.image_size, seq_len=cfg.seq_len, seed=cfg.seed)
+            cfg.dataset, cfg.data_path, train=True, **data_kw)
         self.eval_data = datasets_lib.build_dataset(
-            cfg.dataset, cfg.data_path, train=False,
-            image_size=cfg.image_size, seq_len=cfg.seq_len, seed=cfg.seed)
+            cfg.dataset, cfg.data_path, train=False, **data_kw)
         nproc = jax.process_count()
         if cfg.global_batch_size % max(nproc, 1):
             raise ValueError("global batch size must divide evenly across hosts")
@@ -71,12 +73,10 @@ class Trainer:
                 f"data-parallel degree {dp} (mesh data x fsdp); e.g. use "
                 f"{(cfg.global_batch_size // dp + 1) * dp}")
         self.local_batch = cfg.global_batch_size // nproc
-        self.train_loader = loader_lib.DataLoader(
-            self.train_data, self.local_batch,
-            sampler_lib.ShardedSampler(len(self.train_data), nproc,
-                                       jax.process_index(), shuffle=True,
-                                       seed=cfg.seed, drop_last=True),
-            num_workers=cfg.workers)
+        train_sampler = sampler_lib.ShardedSampler(
+            len(self.train_data), nproc, jax.process_index(), shuffle=True,
+            seed=cfg.seed, drop_last=True)
+        self.train_loader = self._make_train_loader(train_sampler)
         self.eval_loader = loader_lib.DataLoader(
             self.eval_data, self.local_batch,
             sampler_lib.ShardedSampler(len(self.eval_data), nproc,
@@ -118,6 +118,23 @@ class Trainer:
         log.info("model=%s params=%.2fM devices=%d mesh=%s strategy=%s precision=%s",
                  cfg.model, n_params / 1e6, jax.device_count(),
                  dict(self.mesh.shape), cfg.strategy, cfg.precision)
+
+    def _make_train_loader(self, sampler):
+        """Prefer the C++ batch engine for uint8 array-backed image datasets."""
+        cfg = self.cfg
+        if cfg.native_loader and hasattr(self.train_data, "images_u8"):
+            from pytorch_distributed_training_example_tpu.data import (
+                datasets as ds, native_loader)
+
+            if native_loader.available():
+                log.info("using native C++ batch engine for the input pipeline")
+                return native_loader.NativeDataLoader(
+                    self.train_data.images_u8, self.train_data.labels, sampler,
+                    self.local_batch, ds.CIFAR_MEAN, ds.CIFAR_STD,
+                    augment=getattr(self.train_data, "augment", False),
+                    num_threads=max(cfg.workers, 1))
+        return loader_lib.DataLoader(self.train_data, self.local_batch, sampler,
+                                     num_workers=cfg.workers)
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -175,8 +192,17 @@ class Trainer:
         tput = Throughput()
         t_step = time.perf_counter()
         it = prefetch.device_prefetch(self.train_loader, self.batch_sharding)
+        watchdog = watchdog_lib.Watchdog(timeout_s=1800).start()
+        try:
+            self._train_epoch_inner(epoch, it, loss_m, tput, t_step, watchdog)
+        finally:
+            watchdog.stop()
+
+    def _train_epoch_inner(self, epoch, it, loss_m, tput, t_step, watchdog):
+        cfg = self.cfg
         with mesh_lib.use_mesh(self.mesh):
             for i, batch in enumerate(it):
+                watchdog.beat()
                 if i >= self.steps_per_epoch:
                     break
                 gstep = epoch * self.steps_per_epoch + i

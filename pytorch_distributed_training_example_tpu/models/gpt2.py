@@ -1,0 +1,161 @@
+"""GPT-2 decoder LM — the reference's "GPT-2 124M LM" config (BASELINE.json
+configs[3]: FSDP -> GSPMD param-shard).
+
+Architecture (standard GPT-2): learned token+position embeddings, pre-LN
+blocks, GELU MLP at 4x width, biased projections, weight-tied LM head.
+
+TPU-first details:
+- QKV projections are ``DenseGeneral`` with kernels shaped [d_model, heads,
+  head_dim] so tensor-parallel rules shard the *head* dimension (Megatron
+  column-split) purely via PartitionSpec — no parallel linear classes.
+- Activations carry sharding constraints (batch over data axes, sequence
+  over 'context') so CP/ring-attention engages by mesh shape alone.
+- ``remat`` wraps each block in ``jax.checkpoint`` (the reference matrix's
+  gradient-checkpointing capability).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
+
+BATCH = mesh_lib.BATCH_AXES
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any
+    param_dtype: Any
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        dg = lambda name: nn.DenseGeneral(
+            (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name)
+        q, k, v = dg("query")(x), dg("key")(x), dg("value")(x)
+        q = mesh_lib.constrain(q, P(BATCH, "context", "model", None))
+        k = mesh_lib.constrain(k, P(BATCH, "context", "model", None))
+        v = mesh_lib.constrain(v, P(BATCH, "context", "model", None))
+        out = attn_lib.attention(q, k, v, causal=True, impl=self.attn_impl)
+        out = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                              param_dtype=self.param_dtype, name="out")(out)
+        if self.dropout > 0:
+            out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        return out
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any
+    param_dtype: Any
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        ln = lambda name: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        x = x + SelfAttention(self.num_heads, self.dtype, self.param_dtype,
+                              self.dropout, self.attn_impl,
+                              name="attn")(ln("ln_1")(x), train)
+        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        h = ln("ln_2")(x)
+        d = x.shape[-1]
+        h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp_up")(h)
+        h = mesh_lib.constrain(h, P(BATCH, "context", "model"))
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_down")(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        return mesh_lib.constrain(x, P(BATCH, "context", None))
+
+
+class GPT2(nn.Module):
+    vocab_size: int = 50257
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    max_seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        B, S = tokens.shape
+        emb = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="wte")
+        pos_emb = self.param("wpe", nn.initializers.normal(0.01),
+                             (self.max_seq_len, self.d_model), self.param_dtype)
+        x = emb(tokens) + pos_emb[None, :S].astype(self.dtype)
+        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        block_cls = Block
+        if self.remat:
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+                static_argnums=(1,))
+        for i in range(self.num_layers):
+            x = block_cls(self.num_heads, self.mlp_ratio, self.dtype,
+                          self.param_dtype, self.dropout, self.attn_impl,
+                          name=f"block_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
+        # Weight-tied LM head (GPT-2 convention).
+        logits = emb.attend(x.astype(self.param_dtype))
+        return logits.astype(jnp.float32)
+
+
+#: Tensor-parallel rule table (path regex -> PartitionSpec). AUTO_FSDP
+#: composition happens in parallel.sharding when the mesh has an fsdp axis.
+TP_RULES = (
+    (r"attn/(query|key|value)/kernel", P(None, "model", None)),
+    (r"attn/(query|key|value)/bias", P("model", None)),
+    (r"attn/out/kernel", P("model", None, None)),
+    (r"mlp_up/kernel", P(None, "model")),
+    (r"mlp_up/bias", P("model")),
+    (r"mlp_down/kernel", P("model", None)),
+    (r"wte/embedding", P(None, "model")),
+)
+
+
+def gpt2_124m(**kw) -> GPT2:
+    return GPT2(**kw)
+
+
+def gpt2_tiny(**kw) -> GPT2:
+    """4-layer toy for tests/dry-runs."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("d_model", 128)
+    kw.setdefault("max_seq_len", 256)
+    return GPT2(**kw)
+
+
+def num_params(cfg: GPT2) -> int:
+    d, L, V = cfg.d_model, cfg.num_layers, cfg.vocab_size
+    per_block = 4 * d * d + 4 * d + 2 * cfg.mlp_ratio * d * d \
+        + (cfg.mlp_ratio + 1) * d + 4 * d
+    return V * d + cfg.max_seq_len * d + L * per_block + 2 * d

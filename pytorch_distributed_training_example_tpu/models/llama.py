@@ -1,0 +1,232 @@
+"""Llama-3 family — the reference's large-model config (BASELINE.json
+configs[4]: Llama-3 8B, FSDP + gradient checkpointing on v5p-32).
+
+Standard Llama-3 architecture: RMSNorm (pre-norm), rotary position
+embeddings (theta 500k), grouped-query attention (8 KV heads), SwiGLU MLP,
+no biases, untied output head.
+
+TPU-first: same sharding-by-annotation scheme as gpt2.py (heads sharded on
+'model', sequence on 'context', GQA KV heads replicated across TP when
+num_kv_heads < tp); ``remat`` per block for the grad-checkpoint config;
+``scan_layers`` trades python-loop unrolling for an ``nn.scan`` over a
+stacked block (constant compile time at depth 32+, params gain a leading
+layer dim handled by the partition rules).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
+
+BATCH = mesh_lib.BATCH_AXES
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.epsilon)
+        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings on [B, S, H, D] (rotate half, fp32 trig)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?,S,d/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float
+    dtype: Any
+    param_dtype: Any
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        B, S, d = x.shape
+        dg = lambda heads, name: nn.DenseGeneral(
+            (heads, self.head_dim), axis=-1, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name)
+        q = dg(self.num_heads, "query")(x)
+        k = dg(self.num_kv_heads, "key")(x)
+        v = dg(self.num_kv_heads, "value")(x)
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        q = rope(q, positions, self.rope_theta)
+        k = rope(k, positions, self.rope_theta)
+        q = mesh_lib.constrain(q, P(BATCH, "context", "model", None))
+        k = mesh_lib.constrain(k, P(BATCH, "context", "model", None))
+        v = mesh_lib.constrain(v, P(BATCH, "context", "model", None))
+        out = attn_lib.attention(q, k, v, causal=True, impl=self.attn_impl)
+        return nn.DenseGeneral(d, axis=(-2, -1), use_bias=False,
+                               dtype=self.dtype, param_dtype=self.param_dtype,
+                               name="out")(out)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    ffn_dim: int
+    rope_theta: float
+    dtype: Any
+    param_dtype: Any
+    attn_impl: str = "auto"
+    num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        rn = lambda name: RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                                  name=name)
+        x = x + LlamaAttention(self.num_heads, self.num_kv_heads, self.head_dim,
+                               self.rope_theta, self.dtype, self.param_dtype,
+                               self.attn_impl, name="attn")(rn("attn_norm")(x), train)
+        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        h = rn("mlp_norm")(x)
+        d = x.shape[-1]
+        if self.num_experts > 0:
+            from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
+
+            h = MoEBlock(self.num_experts, self.ffn_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="moe")(h, train)
+        else:
+            dense = lambda feat, name: nn.Dense(
+                feat, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name)
+            gate = dense(self.ffn_dim, "gate")(h)
+            up = dense(self.ffn_dim, "up")(h)
+            gate = mesh_lib.constrain(gate, P(BATCH, "context", "model"))
+            up = mesh_lib.constrain(up, P(BATCH, "context", "model"))
+            h = dense(d, "down")(nn.silu(gate) * up)
+        x = x + h
+        return mesh_lib.constrain(x, P(BATCH, "context", None))
+
+
+class Llama(nn.Module):
+    vocab_size: int = 128256
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    d_model: int = 4096
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = False
+    attn_impl: str = "auto"
+    num_experts: int = 0
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.num_heads
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="embed")(tokens)
+        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+
+        block_cls = LlamaBlock
+        if self.remat:
+            block_cls = nn.remat(
+                LlamaBlock, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        block_args = dict(
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim, ffn_dim=self.ffn_dim,
+            rope_theta=self.rope_theta, dtype=self.dtype,
+            param_dtype=self.param_dtype, attn_impl=self.attn_impl,
+            num_experts=self.num_experts)
+        if self.scan_layers:
+            # One stacked block scanned over a leading 'layers' dim: constant
+            # trace/compile cost regardless of depth. The body wrapper adapts
+            # LlamaBlock's single-array return to scan's (carry, ys) contract.
+            inner = block_cls
+
+            class _ScanBody(nn.Module):
+                @nn.compact
+                def __call__(self, carry, _):
+                    return inner(name="block", **block_args)(carry, train), None
+
+            ScanBlocks = nn.scan(
+                _ScanBody, variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_layers)
+            x, _ = ScanBlocks(name="blocks")(x, None)
+        else:
+            for i in range(self.num_layers):
+                x = block_cls(name=f"block_{i}", **block_args)(x, train)
+        x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="final_norm")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+TP_RULES = (
+    (r"attn/(query|key|value)/kernel", P(None, "model", None)),
+    (r"attn/out/kernel", P("model", None, None)),
+    (r"(gate|up)/kernel", P(None, "model")),
+    (r"down/kernel", P("model", None)),
+    (r"embed/embedding", P(None, "model")),
+    (r"lm_head/kernel", P(None, "model")),
+    # MoE variant: experts sharded on the expert axis (EP), router replicated.
+    (r"moe/experts/w_(up|down)", P("expert", None, "model")),
+    (r"moe/router/kernel", P()),
+)
+
+
+def llama3_8b(**kw) -> Llama:
+    return Llama(**kw)
+
+
+def llama_tiny(**kw) -> Llama:
+    """Test-scale Llama (same architecture, toy dims)."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("d_model", 128)
+    kw.setdefault("ffn_dim", 256)
+    kw.setdefault("max_seq_len", 256)
+    return Llama(**kw)
+
+
+def llama_moe_tiny(**kw) -> Llama:
+    """Test-scale MoE Llama (8 experts, top-2 routing)."""
+    kw.setdefault("num_experts", 8)
+    return llama_tiny(**kw)
+
+
+def num_params(cfg: Llama) -> int:
+    d, L, V = cfg.d_model, cfg.num_layers, cfg.vocab_size
+    hd = cfg.head_dim
+    attn = d * cfg.num_heads * hd + 2 * d * cfg.num_kv_heads * hd \
+        + cfg.num_heads * hd * d
+    mlp = 3 * d * cfg.ffn_dim
+    return V * d + L * (attn + mlp + 2 * d) + d + d * V

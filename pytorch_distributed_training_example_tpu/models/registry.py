@@ -50,6 +50,93 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
     )
 
 
+@register("vit_b16")
+def _vit_b16(*, num_classes, image_size, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import vit
+
+    module = vit.vit_b16(num_classes=num_classes, dtype=dtype,
+                         param_dtype=param_dtype, remat=remat, dropout=0.1)
+    return ModelBundle(
+        module=module, task="classification",
+        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
+        fwd_flops_per_example=vit.flops_per_image(image_size),
+        rules={"fsdp_tp": vit.TP_RULES, "tp": vit.TP_RULES},
+    )
+
+
+@register("vit_tiny")
+def _vit_tiny(*, num_classes, image_size, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import vit
+
+    module = vit.vit_tiny(num_classes=num_classes, dtype=dtype,
+                          param_dtype=param_dtype, remat=remat)
+    return ModelBundle(
+        module=module, task="classification",
+        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
+        fwd_flops_per_example=vit.flops_per_image(image_size, 4, 2, 64, 128),
+        rules={"fsdp_tp": vit.TP_RULES, "tp": vit.TP_RULES},
+    )
+
+
+def _lm_bundle(module, tp_rules, seq_len, n_params_fn):
+    from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+
+    flops_tok = metrics_lib.transformer_flops_per_token(
+        n_params_fn(module), seq_len, module.num_layers, module.d_model)
+    return ModelBundle(
+        module=module, task="lm",
+        input_template=(jnp.zeros((2, seq_len), jnp.int32),),
+        fwd_flops_per_example=flops_tok * seq_len,
+        rules={"fsdp_tp": tp_rules, "tp": tp_rules},
+        examples_unit="sequences",
+    )
+
+
+@register("gpt2")
+def _gpt2(*, seq_len, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import gpt2
+
+    module = gpt2.gpt2_124m(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                            max_seq_len=max(seq_len, 1024))
+    return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
+
+
+@register("gpt2_tiny")
+def _gpt2_tiny(*, seq_len, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import gpt2
+
+    module = gpt2.gpt2_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                            max_seq_len=max(seq_len, 256))
+    return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
+
+
+@register("llama3_8b")
+def _llama3_8b(*, seq_len, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    module = llama.llama3_8b(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                             max_seq_len=max(seq_len, 8192))
+    return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
+
+
+@register("llama_tiny")
+def _llama_tiny(*, seq_len, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    module = llama.llama_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                              max_seq_len=max(seq_len, 256))
+    return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
+
+
+@register("llama_moe_tiny")
+def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, **_):
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    module = llama.llama_moe_tiny(dtype=dtype, param_dtype=param_dtype,
+                                  remat=remat, max_seq_len=max(seq_len, 256))
+    return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
+
+
 @register("resnet18")
 def _resnet18(*, num_classes, image_size, dtype, param_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import resnet

@@ -1,0 +1,145 @@
+"""ViT-B/16 — the reference's "ViT-B/16 / ImageNet-1k" config (BASELINE.json
+configs[2]: DDP -> pjit data-parallel).
+
+Standard ViT: 16x16 conv patch embedding, class token, learned position
+embeddings, pre-LN encoder blocks (MSA + GELU MLP), LN + linear head.
+Dropout is plumbed for the classic recipe; attention is the shared
+ops.attention dispatcher so flash/ring engage by shape/mesh exactly as for
+the LMs (bidirectional here — ``causal=False``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
+
+BATCH = mesh_lib.BATCH_AXES
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any
+    param_dtype: Any
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        ln = lambda name: nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        h = ln("ln_1")(x)
+        dg = lambda name: nn.DenseGeneral((self.num_heads, head_dim), axis=-1,
+                                          dtype=self.dtype,
+                                          param_dtype=self.param_dtype, name=name)
+        q, k, v = dg("attn_query")(h), dg("attn_key")(h), dg("attn_value")(h)
+        q = mesh_lib.constrain(q, P(BATCH, None, "model", None))
+        k = mesh_lib.constrain(k, P(BATCH, None, "model", None))
+        v = mesh_lib.constrain(v, P(BATCH, None, "model", None))
+        h = attn_lib.attention(q, k, v, causal=False, impl=self.attn_impl)
+        h = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                            param_dtype=self.param_dtype, name="attn_out")(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+
+        h = ln("ln_2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp_up")(h)
+        h = mesh_lib.constrain(h, P(BATCH, None, "model"))
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_down")(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        return mesh_lib.constrain(x, P(BATCH, None, None))
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        p = self.patch_size
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(images.astype(self.dtype))
+        B, gh, gw, d = x.shape
+        x = x.reshape(B, gh * gw, d)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, d), self.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype), (B, 1, d)), x],
+                            axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw + 1, d), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = mesh_lib.constrain(x, P(BATCH, None, None))
+
+        block_cls = EncoderBlock
+        if self.remat:
+            block_cls = nn.remat(
+                EncoderBlock, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+                static_argnums=(1,))
+        for i in range(self.num_layers):
+            x = block_cls(self.num_heads, self.mlp_dim, self.dtype,
+                          self.param_dtype, self.dropout, self.attn_impl,
+                          name=f"block_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
+        cls_repr = x[:, 0]
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="head")(cls_repr)
+        return logits.astype(jnp.float32)
+
+
+TP_RULES = (
+    (r"attn_(query|key|value)/kernel", P(None, "model", None)),
+    (r"attn_(query|key|value)/bias", P("model", None)),
+    (r"attn_out/kernel", P("model", None, None)),
+    (r"mlp_up/kernel", P(None, "model")),
+    (r"mlp_up/bias", P("model")),
+    (r"mlp_down/kernel", P("model", None)),
+)
+
+
+def vit_b16(**kw) -> ViT:
+    return ViT(**kw)
+
+
+def vit_tiny(**kw) -> ViT:
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("mlp_dim", 128)
+    kw.setdefault("patch_size", 4)
+    return ViT(**kw)
+
+
+def flops_per_image(image_size: int = 224, patch: int = 16, L: int = 12,
+                    d: int = 768, mlp: int = 3072) -> float:
+    """Forward FLOPs (ViT-B/16 @224 ~= 17.6 GFLOP)."""
+    S = (image_size // patch) ** 2 + 1
+    per_block = 2 * S * (4 * d * d + 2 * d * mlp) + 2 * 2 * S * S * d
+    return L * per_block + 2 * S * 3 * d * patch * patch

@@ -1,0 +1,1 @@
+"""TPU compute ops: attention family (XLA, Pallas flash, ring, Ulysses) and collectives."""

@@ -1,0 +1,260 @@
+"""Attention family: XLA reference, ring (context-parallel) and Ulysses.
+
+The reference has no attention of its own (its models come from torchvision /
+minimal GPT-2; long-context parallelism is absent, SURVEY.md §5) — but the
+framework treats sequence/context parallelism as first-class (§2c):
+
+- :func:`dot_product_attention` — the single-device oracle. Plain XLA ops:
+  on TPU, XLA fuses QK^T -> softmax -> PV into an MXU-friendly pipeline; the
+  Pallas flash kernel (ops/flash_attention.py) replaces it when profitable.
+- :func:`ring_attention` — context-parallel attention: Q stays put, K/V
+  blocks rotate around the ``context`` mesh axis via ``ppermute`` (ICI
+  neighbors on the torus), with blockwise online-softmax accumulation, so
+  sequence length scales with the number of chips while memory per chip
+  stays O(S/c * S/c).
+- :func:`ulysses_attention` — all-to-all alternative: swap sequence-sharding
+  for head-sharding around the attention core (preferable when
+  heads >= context shards and full-sequence attention per head is cheap).
+
+Shapes follow the TPU-native convention ``[batch, seq, heads, head_dim]``
+(BSHD; heads before head_dim keeps the trailing 128-lane dim dense for the
+MXU). GQA is supported by passing fewer K/V heads than Q heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Broadcast GQA KV heads up to the Q head count."""
+    num_kv = k.shape[2]
+    if num_kv == num_q_heads:
+        return k
+    assert num_q_heads % num_kv == 0, (num_q_heads, num_kv)
+    return jnp.repeat(k, num_q_heads // num_kv, axis=2)
+
+
+def dot_product_attention(
+    q: jax.Array,           # [B, Sq, H, D]
+    k: jax.Array,           # [B, Skv, Hkv, D]
+    v: jax.Array,           # [B, Skv, Hkv, D]
+    *,
+    causal: bool = False,
+    bias: jax.Array | None = None,
+    q_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Reference attention in pure XLA; fp32 softmax, inputs' dtype out.
+
+    ``q_offset`` positions the query block within the global sequence for
+    causal masking (used by the ring schedule where K/V blocks come from
+    other context shards).
+    """
+    orig_dtype = q.dtype
+    depth = q.shape[-1]
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (1.0 / math.sqrt(depth))
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2) + q_offset
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (context parallelism) — SURVEY.md §2c "Ring attention"
+# ---------------------------------------------------------------------------
+
+
+def _online_block(q, k, v, *, causal, q_offset, k_offset, m, l, acc):
+    """One ring step: attend q against a K/V block, updating the online
+    softmax state (m: running max, l: running denom, acc: unnormalized out)."""
+    depth = q.shape[-1]
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (1.0 / math.sqrt(depth))
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2) + q_offset
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3) + k_offset
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    block_max = jnp.max(logits, axis=-1)               # [B,H,Q]
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(logits - new_m[..., None])             # [B,H,Q,K]
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(jnp.float32),
+                    v.astype(jnp.float32), preferred_element_type=jnp.float32)
+    new_acc = acc * correction[..., None] + pv
+    return new_m, new_l, new_acc
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "context",
+    causal: bool = False,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "model",
+) -> jax.Array:
+    """Context-parallel attention over the ``axis`` mesh dimension.
+
+    Inputs are globally-shaped ``[B, S, H, D]`` arrays whose sequence dim is
+    sharded over ``axis``; inside ``shard_map`` each device holds its local
+    ``S/c`` block, and K/V blocks rotate around the ring with ``ppermute``
+    (one ICI hop per step — neighbor exchange rides the torus). The online
+    softmax keeps the result exactly equal to full attention (tested against
+    :func:`dot_product_attention` on a fake 8-device mesh).
+
+    The head dim stays sharded on ``head_axis`` (tensor parallelism composes
+    with the ring: each TP shard rings its own head slice). With
+    ``causal=True``, blocks entirely masked out still circulate (the ring
+    must stay in lockstep) but their contribution is identically zero.
+    """
+    c = mesh.shape[axis]
+    if c == 1:
+        return dot_product_attention(q, k, v, causal=causal)
+    # Keep heads TP-sharded only when BOTH q and kv head counts divide by the
+    # TP degree — otherwise local GQA head-group pairing would be wrong, so
+    # fall back to replicated heads inside the ring.
+    tp = mesh.shape.get(head_axis, 1)
+    h_ax = head_axis if (tp > 1 and q.shape[2] % tp == 0
+                         and k.shape[2] % tp == 0) else None
+
+    def local_fn(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        s_local = q.shape[1]
+        B, _, H, D = q.shape
+        m = jnp.full((B, H, s_local), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, s_local), jnp.float32)
+        acc = jnp.zeros((B, H, s_local, D), jnp.float32)
+        q_offset = idx * s_local
+
+        def body(step, carry):
+            m, l, acc, kb, vb = carry
+            # K/V block currently held came from shard (idx - step) mod c.
+            src = (idx - step) % c
+            k_offset = src * s_local
+            m, l, acc = _online_block(q, kb, vb, causal=causal,
+                                      q_offset=q_offset, k_offset=k_offset,
+                                      m=m, l=l, acc=acc)
+            # Rotate: send our block to the next shard, receive previous.
+            perm = [(j, (j + 1) % c) for j in range(c)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return m, l, acc, kb, vb
+
+        m, l, acc, _, _ = jax.lax.fori_loop(0, c, body, (m, l, acc, k, v))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]   # [B,H,Q,D]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+    spec = P(batch_axes, axis, h_ax, None)
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all sequence<->head) — SURVEY.md §2c "Ulysses"
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "context",
+    causal: bool = False,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "model",
+) -> jax.Array:
+    """All-to-all context parallelism: trade sequence-sharding for
+    head-sharding, run full-sequence attention per (local) head, trade back.
+
+    Requires the per-TP-shard head count to divide by the context shards
+    (GQA KV heads are broadcast up first when smaller than the shard count).
+    """
+    c = mesh.shape[axis]
+    if c == 1:
+        return dot_product_attention(q, k, v, causal=causal)
+    tp = mesh.shape.get(head_axis, 1)
+    h_ax = head_axis if (tp > 1 and q.shape[2] % tp == 0
+                         and k.shape[2] % tp == 0) else None
+    local_heads = q.shape[2] // (tp if h_ax else 1)
+    if local_heads % c:
+        raise ValueError(
+            f"ulysses needs local heads ({local_heads}) divisible by {axis} "
+            f"shards ({c})")
+
+    def local_fn(q, k, v):
+        # [B, S/c, H', D] -> all_to_all -> [B, S, H'/c, D]
+        def seq_to_heads(x):
+            if x.shape[2] % c:   # GQA KV with fewer heads than shards
+                x = _repeat_kv(x, c)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        out = dot_product_attention(qh, kh, vh, causal=causal)
+        # [B, S, H'/c, D] -> back to [B, S/c, H', D]
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(batch_axes, axis, h_ax, None)
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def attention(
+    q, k, v, *, causal=False, impl: str = "auto",
+    mesh: Mesh | None = None, context_axis: str = "context",
+    batch_axes=("data", "fsdp"),
+):
+    """Dispatcher used by the models.
+
+    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'. 'auto' picks ring
+    when the ambient mesh has a context axis > 1, the Pallas flash kernel on
+    TPU for long sequences, else plain XLA.
+    """
+    from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+    mesh = mesh or mesh_lib.current_mesh()
+    ctx = mesh.shape.get(context_axis, 1) if mesh is not None else 1
+    if impl == "auto":
+        if ctx > 1:
+            impl = "ring"
+        else:
+            impl = "flash" if _flash_eligible(q, k) else "xla"
+    if impl == "ring":
+        return ring_attention(q, k, v, mesh=mesh, axis=context_axis,
+                              causal=causal, batch_axes=batch_axes)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, mesh=mesh, axis=context_axis,
+                                 causal=causal, batch_axes=batch_axes)
+    if impl == "flash":
+        from pytorch_distributed_training_example_tpu.ops import flash_attention
+
+        return flash_attention.flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal)
+
+
+def _flash_eligible(q, k) -> bool:
+    on_tpu = jax.default_backend() not in ("cpu",)
+    seq_ok = q.shape[1] >= 1024 and q.shape[1] % 512 == 0 and k.shape[1] % 512 == 0
+    return on_tpu and seq_ok and q.shape[-1] in (64, 128, 256)

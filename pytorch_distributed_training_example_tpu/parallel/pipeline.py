@@ -1,0 +1,128 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``stage`` mesh axis.
+
+SURVEY.md §2c "PP": the reference has none (DDP example); the TPU-native
+design is stage-sliced parameters + a microbatch schedule where activations
+hop between neighboring stages with ``ppermute`` (one ICI hop — stages map
+to adjacent chips on the torus).
+
+Design: ``shard_map`` over the ``stage`` axis. Parameters are stacked with a
+leading ``[num_stages, ...]`` dim sharded on ``stage`` (each chip holds one
+stage's weights). The schedule is the classic GPipe fill/steady/drain loop:
+at tick ``t``, stage ``s`` processes microbatch ``t - s`` (when valid), then
+passes its activation to stage ``s+1``. Total ticks = M + S - 1; bubble
+fraction (S-1)/(M+S-1) — choose microbatches >= 4x stages. Backward is just
+``jax.grad`` through the loop: ``ppermute`` transposes to the reverse
+permutation, giving the symmetric backward pipeline automatically.
+
+The stage function must be shape-preserving (activation in == activation
+out), which transformer blocks satisfy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage param pytrees along a new leading dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "stage",
+    batch_axes=mesh_lib.BATCH_AXES,
+) -> jax.Array:
+    """Run ``stage_fn`` as an S-stage pipeline over microbatches of ``x``.
+
+    Args:
+        stage_fn: ``(params_for_one_stage, x_mb) -> y_mb``, shape-preserving.
+        stage_params: pytree whose leaves have leading dim ``num_stages``
+            (see :func:`stack_stage_params`), sharded on ``axis``.
+        x: ``[batch, ...]`` global input; batch must divide by
+            ``num_microbatches`` (and the data axes).
+    Returns:
+        ``[batch, ...]`` output, equal to applying all stages sequentially.
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+    if S == 1:
+        def seq_fn(params, x):
+            for i in range(params_leading_dim(stage_params)):
+                x = stage_fn(jax.tree.map(lambda p: p[i], stage_params), x)
+            return x
+        return seq_fn(stage_params, x)
+    B = x.shape[0]
+    assert B % M == 0, (B, M)
+    mb = B // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+
+    def per_stage(params_local, x_mb):
+        # shard_map gives the local stage slice with leading dim 1: drop it.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params_local)
+        stage = jax.lax.axis_index(axis)
+        act_shape = x_mb.shape[1:]
+        buf = jnp.zeros(act_shape, x_mb.dtype)        # activation entering this stage
+        outs = jnp.zeros_like(x_mb)                   # collected on the last stage
+
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(t, carry):
+            buf, outs = carry
+            mb_idx = t - stage
+            # Stage 0 reads microbatch t from the input; others read buf.
+            src = jnp.where(stage == 0,
+                            jax.lax.dynamic_index_in_dim(
+                                x_mb, jnp.clip(t, 0, M - 1), keepdims=False),
+                            buf)
+            y = stage_fn(params, src)
+            active = (mb_idx >= 0) & (mb_idx < M)
+            # Last stage stores its (valid) result.
+            is_last = stage == S - 1
+            outs = jnp.where(
+                (active & is_last),
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(mb_idx, 0, M - 1), axis=0),
+                outs)
+            buf = jax.lax.ppermute(y, axis, fwd_perm)
+            return buf, outs
+
+        _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (buf, outs))
+        # Replicate the last stage's outputs across the stage axis so the
+        # result is stage-replicated (out_spec has no stage entry).
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    batch_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    out = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(param_specs, batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )(stage_params, x_mb)
+    return out.reshape(B, *x.shape[1:])
+
+
+def params_leading_dim(tree) -> int:
+    return jax.tree.leaves(tree)[0].shape[0]
+
+
+def sequential_apply(stage_fn, stage_params, x):
+    """The single-device oracle: all stages applied in order."""
+    S = params_leading_dim(stage_params)
+    for i in range(S):
+        x = stage_fn(jax.tree.map(lambda p: p[i], stage_params), x)
+    return x

@@ -75,14 +75,38 @@ def _auto_fsdp_spec(shape: Sequence[int], fsdp_size: int, extra: P | None = None
     return P(*taken)
 
 
+def _drop_indivisible(spec: P, shape: Sequence[int], mesh: Mesh) -> P:
+    """Replicate any dim whose size isn't divisible by its assigned axes.
+
+    The standard GQA case: KV-head kernels with fewer heads than the tensor-
+    parallel degree stay replicated across 'model' (each TP shard holds all
+    KV heads) instead of erroring out.
+    """
+    out = []
+    for d, entry in enumerate(spec):
+        if entry is None or d >= len(shape):
+            out.append(entry)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        size = math.prod(mesh.shape.get(a, 1) for a in axes)
+        out.append(entry if shape[d] % size == 0 else None)
+    return P(*out)
+
+
 def spec_for(path: str, shape: Sequence[int], rules: Sequence[Rule], mesh: Mesh) -> P:
     fsdp_size = mesh.shape.get("fsdp", 1)
     for pattern, spec in rules:
         if re.search(pattern, path):
             if isinstance(spec, str) and spec == AUTO_FSDP:
                 return _auto_fsdp_spec(shape, fsdp_size)
+            # nn.scan-stacked layers add exactly one leading 'layers' dim;
+            # rule tables are written for the unstacked rank, so shift the
+            # spec right by one (leading dim replicated).
+            if len(shape) == len(spec) + 1:
+                spec = P(None, *spec)
             # Compose explicit (e.g. TP) specs with auto-fsdp on a free dim.
             spec = mesh_lib._prune_spec(spec, mesh)
+            spec = _drop_indivisible(spec, shape, mesh)
             return _auto_fsdp_spec(shape, fsdp_size, extra=spec) if fsdp_size > 1 else spec
     return _auto_fsdp_spec(shape, fsdp_size)
 

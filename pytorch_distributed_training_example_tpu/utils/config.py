@@ -44,6 +44,7 @@ class Config:
     # io
     data_path: str | None = None
     workers: int = 4
+    native_loader: bool = True  # C++ batch engine when dataset supports it
     log_every: int = 50
     eval_every_epochs: int = 1
     checkpoint_dir: str | None = None

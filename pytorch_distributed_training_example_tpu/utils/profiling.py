@@ -1,0 +1,61 @@
+"""Profiling hooks — SURVEY.md §5 "tracing/profiling".
+
+The reference has none beyond optional CUDA-event timing; here the TPU-native
+mechanism is ``jax.profiler`` traces (viewable in TensorBoard/Perfetto, with
+per-HLO timing from the xplane dump on TPU) plus named step phases.
+
+Used by the trainer's ``--profile-steps a:b`` flag; also usable standalone::
+
+    with profiling.trace("/tmp/trace"):
+        step(state, batch)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the TPU trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock step timing with device-sync on demand.
+
+    Async dispatch means host timestamps around ``step()`` measure dispatch,
+    not execution; call ``sync()`` (blocks on the metrics) at measurement
+    boundaries only, the way bench.py does.
+    """
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._t0: float | None = None
+
+    def start(self, sync_on=None):
+        if sync_on is not None:
+            jax.tree.map(lambda x: x.block_until_ready(), sync_on)
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.tree.map(lambda x: x.block_until_ready(), sync_on)
+        dt = time.perf_counter() - self._t0
+        self.times.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)

@@ -1,0 +1,107 @@
+"""Guard rails: hang watchdog, NaN debugging, donation-safe blocking.
+
+SURVEY.md §5 "race detection / sanitizers": JAX's functional model removes
+data races by construction; what remains are (a) collective deadlocks — one
+host stops feeding steps and the rest block inside a collective forever
+(the reference relies on the NCCL watchdog for this), (b) NaN propagation,
+(c) host-side input races. This module covers (a) and (b); (c) is handled
+by the loader's deterministic per-slot queues.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import sys
+import threading
+import time
+
+import jax
+
+log = logging.getLogger("pdtx")
+
+
+class Watchdog:
+    """Dead-man's switch for the train loop (NCCL-watchdog equivalent).
+
+    ``beat()`` every step; if no beat arrives within ``timeout_s`` the
+    watchdog dumps all Python thread stacks to stderr (so a hung collective
+    is diagnosable post-mortem) and, with ``fatal=True``, aborts the process
+    so a supervisor can restart from the latest checkpoint — the TPU
+    recovery model (gang-scheduled slices restart; no elastic shrink).
+    """
+
+    def __init__(self, timeout_s: float = 600.0, fatal: bool = False):
+        self.timeout_s = timeout_s
+        self.fatal = fatal
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.wait(min(self.timeout_s / 4, 30.0)):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout_s:
+                log.error(
+                    "watchdog: no step progress for %.0fs (timeout %.0fs) — "
+                    "likely a hung collective; dumping stacks", idle, self.timeout_s)
+                faulthandler.dump_traceback(file=sys.stderr)
+                if self.fatal:
+                    import os
+
+                    os.abort()
+                self._last = time.monotonic()  # don't spam
+
+
+def block_until_ready_with_timeout(tree, timeout_s: float = 600.0):
+    """block_until_ready that raises instead of hanging forever."""
+    done = threading.Event()
+    err: list[BaseException] = []
+
+    def target():
+        try:
+            jax.tree.map(lambda x: x.block_until_ready(), tree)
+        except BaseException as e:  # surfaced to caller
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        faulthandler.dump_traceback(file=sys.stderr)
+        raise TimeoutError(
+            f"device results not ready after {timeout_s}s — hung collective?")
+    if err:
+        raise err[0]
+
+
+def enable_nan_checks():
+    """Trap NaNs at the op that produced them (debug runs; slows compile)."""
+    jax.config.update("jax_debug_nans", True)
+
+
+def check_donation_safety(fn):
+    """Wrap a donated-arg jitted fn to give a clear error on reuse-after-donate."""
+    def wrapper(state, *a, **kw):
+        try:
+            return fn(state, *a, **kw)
+        except RuntimeError as e:
+            if "donated" in str(e) or "deleted" in str(e):
+                raise RuntimeError(
+                    "train state was reused after being donated to the step; "
+                    "always rebind: `state, metrics = train_step(state, batch)`"
+                ) from e
+            raise
+    return wrapper

@@ -1,0 +1,102 @@
+"""Ring/Ulysses/flash attention vs the XLA oracle (SURVEY.md §4.2, §7(c))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+from pytorch_distributed_training_example_tpu.ops import attention as A
+from pytorch_distributed_training_example_tpu.ops import flash_attention as F
+
+
+def _qkv(B=2, S=64, H=4, Hkv=None, D=16, seed=0):
+    r = np.random.RandomState(seed)
+    mk = lambda h: jnp.asarray(r.randn(B, S, h, D), jnp.float32)
+    return mk(H), mk(Hkv or H), mk(Hkv or H)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_oracle(devices, causal):
+    mesh = mesh_lib.build_mesh({"context": 8})
+    q, k, v = _qkv()
+    ref = A.dot_product_attention(q, k, v, causal=causal)
+    out = A.ring_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_gqa_and_grads(devices):
+    mesh = mesh_lib.build_mesh({"context": 4, "data": 2})
+    q, k, v = _qkv(H=4, Hkv=2)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    out = A.ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda *a: A.ring_attention(*a, mesh=mesh, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_oracle(devices, causal):
+    mesh = mesh_lib.build_mesh({"context": 4, "data": 2})
+    q, k, v = _qkv(H=8)
+    ref = A.dot_product_attention(q, k, v, causal=causal)
+    out = A.ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_head_divisibility_error(devices):
+    mesh = mesh_lib.build_mesh({"context": 8})
+    q, k, v = _qkv(H=4)
+    with pytest.raises(ValueError, match="divisible"):
+        A.ulysses_attention(q, k, v, mesh=mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_interpret(causal):
+    q, k, v = _qkv(S=128)
+    ref = A.dot_product_attention(q, k, v, causal=causal)
+    with pltpu.force_tpu_interpret_mode():
+        out = F.flash_attention(q, k, v, causal, 32, 32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_grads_interpret():
+    q, k, v = _qkv(S=64)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(lambda *a: F.flash_attention(*a, True, 32, 32).sum(),
+                         argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gqa_repeat():
+    q, k, v = _qkv(H=8, Hkv=2)
+    ref = A.dot_product_attention(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2))
+    out = A.dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-6)
+
+
+def test_ring_and_ulysses_with_tp_heads(devices):
+    """CP composes with TP: heads stay sharded on 'model' inside the ring."""
+    mesh = mesh_lib.build_mesh({"context": 2, "model": 2, "data": 2})
+    q, k, v = _qkv(S=32)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    ring = A.ring_attention(q, k, v, mesh=mesh, causal=True)
+    ul = A.ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(ring),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(ul),
+                               rtol=1e-5, atol=1e-5)
