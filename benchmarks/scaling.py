@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Scaling-efficiency sweep — the driver's second metric (BASELINE.json:
+"DDP scaling efficiency v4-8 -> v4-32", target >= 90%).
+
+Runs the bench at increasing data-parallel degree over the available chips
+and reports throughput plus efficiency relative to linear scaling from the
+smallest size. With one real chip (this CI), ``--fake-devices N`` exercises
+the harness on a fake CPU mesh so the sweep logic itself stays tested; on a
+pod slice it measures the real ICI gradient-psum overhead.
+
+    python benchmarks/scaling.py                     # all real chips
+    python benchmarks/scaling.py --fake-devices 8    # harness check on CPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--per-chip-batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--sizes", default=None,
+                   help="comma-separated dp sizes (default: powers of 2 up to #chips)")
+    p.add_argument("--fake-devices", type=int, default=None)
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={args.fake_devices}").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from bench import bench
+
+    n = jax.device_count()
+    if args.sizes:
+        sizes = [int(s) for s in args.sizes.split(",")]
+    else:
+        sizes = []
+        s = 1
+        while s <= n:
+            sizes.append(s)
+            s *= 2
+    rows = []
+    for s in sizes:
+        r = bench(args.model, args.image_size, args.per_chip_batch,
+                  steps=args.steps, quiet=True, seq_len=args.seq_len,
+                  mesh_spec={"data": s}, devices=jax.devices()[:s])
+        rows.append({"chips": s, "per_chip": r["value"], "unit": r["unit"],
+                     "mfu": r["extra"]["mfu"]})
+        print(f"# {s} chip(s): {r['value']} {r['unit']}", file=sys.stderr)
+
+    base = rows[0]["per_chip"]
+    for row in rows:
+        row["scaling_efficiency"] = round(row["per_chip"] / base, 4)
+    print(json.dumps({"metric": f"{args.model}_scaling_sweep", "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

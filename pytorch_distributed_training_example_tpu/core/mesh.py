@@ -144,6 +144,21 @@ def use_mesh(mesh: Mesh):
         _local.mesh = prev
 
 
+@contextlib.contextmanager
+def no_constrain():
+    """Disable :func:`constrain` in this trace region.
+
+    Used when model code runs inside ``shard_map`` (pipeline stages), where
+    values are per-device and global sharding constraints don't apply.
+    """
+    prev = getattr(_local, "constrain_disabled", False)
+    _local.constrain_disabled = True
+    try:
+        yield
+    finally:
+        _local.constrain_disabled = prev
+
+
 def constrain(x, spec: P):
     """``with_sharding_constraint`` against the ambient mesh (no-op without one).
 
@@ -152,7 +167,7 @@ def constrain(x, spec: P):
     ``('data','fsdp')`` and ``'model'``) and run unmodified on any mesh shape.
     """
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or getattr(_local, "constrain_disabled", False):
         return x
     spec = _prune_spec(spec, mesh)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

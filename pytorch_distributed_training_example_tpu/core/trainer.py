@@ -53,7 +53,8 @@ class Trainer:
         self.bundle = registry.create_model(
             cfg.model, num_classes=cfg.num_classes, image_size=cfg.image_size,
             seq_len=cfg.seq_len, dtype=self.policy.compute_dtype,
-            param_dtype=self.policy.param_dtype, remat=cfg.remat)
+            param_dtype=self.policy.param_dtype, remat=cfg.remat,
+            sp=cfg.strategy.endswith("_sp"))
 
         # data ------------------------------------------------------------
         vocab = getattr(self.bundle.module, "vocab_size", 50257)
@@ -91,9 +92,20 @@ class Trainer:
         self.tx, self.schedule = optim.build_optimizer(cfg, self.steps_per_epoch)
         scaler = (precision_lib.ScalerState.create()
                   if precision_lib.needs_loss_scaling(self.policy) else None)
-        rules = sharding_lib.strategy_rules(cfg.strategy, self.bundle.rules)
+        model = self.bundle.module
+        if cfg.strategy == "pp":
+            from pytorch_distributed_training_example_tpu.parallel import pp_lm
+
+            if not hasattr(model, "scan_layers"):
+                raise ValueError("strategy 'pp' currently supports the Llama "
+                                 "family (scan-stacked blocks)")
+            model = pp_lm.PipelinedLlama(model, self.mesh,
+                                         cfg.pp_microbatches)
+            rules = pp_lm.PP_RULES
+        else:
+            rules = sharding_lib.strategy_rules(cfg.strategy, self.bundle.rules)
         self.state = train_loop.create_train_state(
-            self.bundle.module, self.tx, self.bundle.input_template,
+            model, self.tx, self.bundle.input_template,
             self.mesh, rules, seed=cfg.seed, scaler=scaler)
 
         task = train_loop.get_task(self.bundle.task, cfg.label_smoothing)

@@ -26,7 +26,12 @@ CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 
 class SyntheticImageDataset:
-    """Deterministic fake images+labels; shaped/normalized like the real thing."""
+    """Deterministic fake images+labels; shaped/normalized like the real thing.
+
+    Each image is noise plus a fixed per-class pattern, so classes are
+    separable — few-epoch convergence tests measure real learning rather
+    than memorization of pure noise.
+    """
 
     def __init__(self, num_examples: int = 51200, image_size: int = 224,
                  num_classes: int = 1000, seed: int = 0):
@@ -34,15 +39,32 @@ class SyntheticImageDataset:
         self.image_size = image_size
         self.num_classes = num_classes
         self.seed = seed
+        pat_rng = np.random.default_rng(seed + 12345)
+        # Low-res patterns upsampled at access: O(classes * 8*8*3) memory.
+        self._pat_res = min(8, image_size)
+        self._patterns = pat_rng.standard_normal(
+            (min(num_classes, 1024), self._pat_res, self._pat_res, 3)
+        ).astype(np.float32)
 
     def __len__(self):
         return self.num_examples
 
     def __getitem__(self, i: int):
         rng = np.random.default_rng((self.seed, i))
-        img = rng.standard_normal((self.image_size, self.image_size, 3), np.float32)
         label = np.int32(i % self.num_classes)
-        return {"image": img, "label": label}
+        img = rng.standard_normal(
+            (self.image_size, self.image_size, 3), np.float32)
+        pat = self._patterns[label % len(self._patterns)]
+        rep = self.image_size // self._pat_res
+        if rep > 1:
+            pat = np.repeat(np.repeat(pat, rep, 0), rep, 1)
+        img = 0.7 * img[: pat.shape[0], : pat.shape[1]] + 0.7 * pat
+        if img.shape[0] != self.image_size:  # image_size not divisible by 8
+            full = rng.standard_normal(
+                (self.image_size, self.image_size, 3)).astype(np.float32)
+            full[: img.shape[0], : img.shape[1]] = img
+            img = full
+        return {"image": img.astype(np.float32), "label": label}
 
 
 class CIFAR10:

@@ -29,6 +29,13 @@ from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
 BATCH = mesh_lib.BATCH_AXES
 
 
+def _seq_axes(sp: bool):
+    """Sequence-dim sharding for the residual stream: with Megatron-style SP
+    on, the sequence also shards over the TP axis between matmul regions
+    (GSPMD inserts the gather/scatter Megatron's SP does by hand)."""
+    return ("context", "model") if sp else "context"
+
+
 class SelfAttention(nn.Module):
     num_heads: int
     dtype: Any
@@ -62,6 +69,7 @@ class Block(nn.Module):
     param_dtype: Any
     dropout: float = 0.0
     attn_impl: str = "auto"
+    sp: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -70,7 +78,7 @@ class Block(nn.Module):
         x = x + SelfAttention(self.num_heads, self.dtype, self.param_dtype,
                               self.dropout, self.attn_impl,
                               name="attn")(ln("ln_1")(x), train)
-        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
         h = ln("ln_2")(x)
         d = x.shape[-1]
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype,
@@ -82,7 +90,7 @@ class Block(nn.Module):
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
-        return mesh_lib.constrain(x, P(BATCH, "context", None))
+        return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
 
 
 class GPT2(nn.Module):
@@ -97,6 +105,7 @@ class GPT2(nn.Module):
     param_dtype: Any = jnp.float32
     remat: bool = False
     attn_impl: str = "auto"
+    sp: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -106,7 +115,7 @@ class GPT2(nn.Module):
         pos_emb = self.param("wpe", nn.initializers.normal(0.01),
                              (self.max_seq_len, self.d_model), self.param_dtype)
         x = emb(tokens) + pos_emb[None, :S].astype(self.dtype)
-        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
         if self.dropout > 0:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
@@ -119,7 +128,7 @@ class GPT2(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.mlp_ratio, self.dtype,
                           self.param_dtype, self.dropout, self.attn_impl,
-                          name=f"block_{i}")(x, train)
+                          self.sp, name=f"block_{i}")(x, train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
         # Weight-tied LM head (GPT-2 convention).

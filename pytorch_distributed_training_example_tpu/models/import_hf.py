@@ -1,0 +1,108 @@
+"""Import HuggingFace (PyTorch) checkpoints into this framework's models.
+
+Interop with the reference's ecosystem: a user coming from the PyTorch
+example can bring torch-trained GPT-2 / Llama weights straight into the
+TPU-native models (and these converters double as numerical parity tests —
+``tests/test_hf_parity.py`` checks our logits against the torch
+implementations to ~1e-4 on random weights).
+
+Conventions converted:
+- HF GPT-2 uses Conv1D ([in, out]) and a fused qkv projection; we split and
+  reshape to [d_model, heads, head_dim] DenseGeneral kernels.
+- HF Llama Linear weights are [out, in]; ours are [in, out] (transposed),
+  attention kernels reshaped to [d, heads, head_dim] / [heads, head_dim, d].
+- Both use rotate-half RoPE and pre-norm, matching our implementations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(t):  # torch tensor -> numpy (no grad, cpu)
+    return t.detach().cpu().numpy()
+
+
+def import_gpt2(hf_model) -> dict:
+    """HF ``GPT2LMHeadModel`` -> params for :class:`models.gpt2.GPT2`."""
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    cfg = hf_model.config
+    d, H = cfg.n_embd, cfg.n_head
+    Dh = d // H
+    params: dict = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "wpe": sd["transformer.wpe.weight"],
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+    }
+    for i in range(cfg.n_layer):
+        p = f"transformer.h.{i}."
+        qkv_w = sd[p + "attn.c_attn.weight"]          # [d, 3d] (Conv1D)
+        qkv_b = sd[p + "attn.c_attn.bias"]            # [3d]
+        qw, kw, vw = np.split(qkv_w, 3, axis=1)
+        qb, kb, vb = np.split(qkv_b, 3)
+        block = {
+            "ln_1": {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            "ln_2": {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+            "attn": {
+                "query": {"kernel": qw.reshape(d, H, Dh), "bias": qb.reshape(H, Dh)},
+                "key": {"kernel": kw.reshape(d, H, Dh), "bias": kb.reshape(H, Dh)},
+                "value": {"kernel": vw.reshape(d, H, Dh), "bias": vb.reshape(H, Dh)},
+                "out": {"kernel": sd[p + "attn.c_proj.weight"].reshape(H, Dh, d),
+                        "bias": sd[p + "attn.c_proj.bias"]},
+            },
+            "mlp_up": {"kernel": sd[p + "mlp.c_fc.weight"],
+                       "bias": sd[p + "mlp.c_fc.bias"]},
+            "mlp_down": {"kernel": sd[p + "mlp.c_proj.weight"],
+                         "bias": sd[p + "mlp.c_proj.bias"]},
+        }
+        params[f"block_{i}"] = block
+    return params
+
+
+def import_llama(hf_model) -> dict:
+    """HF ``LlamaForCausalLM`` -> params for :class:`models.llama.Llama`."""
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    cfg = hf_model.config
+    d = cfg.hidden_size
+    H = cfg.num_attention_heads
+    Hkv = cfg.num_key_value_heads
+    Dh = d // H
+    params: dict = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": {"scale": sd["model.norm.weight"]},
+        "lm_head": {"kernel": sd["lm_head.weight"].T},
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        block = {
+            "attn_norm": {"scale": sd[p + "input_layernorm.weight"]},
+            "mlp_norm": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "attn": {
+                "query": {"kernel": sd[p + "self_attn.q_proj.weight"].T
+                          .reshape(d, H, Dh)},
+                "key": {"kernel": sd[p + "self_attn.k_proj.weight"].T
+                        .reshape(d, Hkv, Dh)},
+                "value": {"kernel": sd[p + "self_attn.v_proj.weight"].T
+                          .reshape(d, Hkv, Dh)},
+                "out": {"kernel": sd[p + "self_attn.o_proj.weight"].T
+                        .reshape(H, Dh, d)},
+            },
+            "gate": {"kernel": sd[p + "mlp.gate_proj.weight"].T},
+            "up": {"kernel": sd[p + "mlp.up_proj.weight"].T},
+            "down": {"kernel": sd[p + "mlp.down_proj.weight"].T},
+        }
+        params[f"block_{i}"] = block
+    return params
+
+
+def to_jax(params, dtype=None):
+    import jax.numpy as jnp
+
+    def conv(x):
+        arr = jnp.asarray(x)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    import jax
+
+    return jax.tree.map(conv, params)

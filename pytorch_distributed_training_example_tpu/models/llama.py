@@ -28,6 +28,12 @@ from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
 BATCH = mesh_lib.BATCH_AXES
 
 
+def _seq_axes(sp: bool):
+    """Residual-stream sequence sharding: Megatron SP shards sequence over
+    the TP axis between matmul regions when enabled (GSPMD reshards)."""
+    return ("context", "model") if sp else "context"
+
+
 class RMSNorm(nn.Module):
     epsilon: float = 1e-5
     dtype: Any = jnp.float32
@@ -96,6 +102,7 @@ class LlamaBlock(nn.Module):
     param_dtype: Any
     attn_impl: str = "auto"
     num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
+    sp: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -104,7 +111,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(self.num_heads, self.num_kv_heads, self.head_dim,
                                self.rope_theta, self.dtype, self.param_dtype,
                                self.attn_impl, name="attn")(rn("attn_norm")(x), train)
-        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
         h = rn("mlp_norm")(x)
         d = x.shape[-1]
         if self.num_experts > 0:
@@ -122,7 +129,7 @@ class LlamaBlock(nn.Module):
             up = mesh_lib.constrain(up, P(BATCH, "context", "model"))
             h = dense(d, "down")(nn.silu(gate) * up)
         x = x + h
-        return mesh_lib.constrain(x, P(BATCH, "context", None))
+        return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
 
 
 class Llama(nn.Module):
@@ -140,6 +147,7 @@ class Llama(nn.Module):
     scan_layers: bool = False
     attn_impl: str = "auto"
     num_experts: int = 0
+    sp: bool = False
 
     @property
     def head_dim(self):
@@ -149,7 +157,7 @@ class Llama(nn.Module):
     def __call__(self, tokens, train: bool = True):
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
-        x = mesh_lib.constrain(x, P(BATCH, "context", None))
+        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
 
         block_cls = LlamaBlock
         if self.remat:
@@ -161,7 +169,7 @@ class Llama(nn.Module):
             head_dim=self.head_dim, ffn_dim=self.ffn_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
             param_dtype=self.param_dtype, attn_impl=self.attn_impl,
-            num_experts=self.num_experts)
+            num_experts=self.num_experts, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts

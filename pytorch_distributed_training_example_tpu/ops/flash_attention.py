@@ -31,9 +31,10 @@ from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
 NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_KV = 512
+LSE_LANES = 8  # lse stored [B,H,S,8]: minor dims satisfy Mosaic tiling
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                 sm_scale: float, causal: bool, block_q: int, block_kv: int):
     qi = pl.program_id(2)
     kvi = pl.program_id(3)
@@ -81,13 +82,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         denom = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # lse rows broadcast over LSE_LANES (Mosaic tiling needs >= 2D tiles).
+        lse_ref[0, 0] = (m_ref[:, :LSE_LANES]
+                         + jnp.log(jnp.maximum(l_ref[:, :LSE_LANES], 1e-30)))
 
 
 def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_kv: int):
+    """Returns (out [B,S,H,D], lse [B,H,S]) with K/V already GQA-expanded."""
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
-    k = attn_lib._repeat_kv(k, H)
-    v = attn_lib._repeat_kv(v, H)
     # head-major layout for the kernel
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
@@ -97,7 +100,7 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_kv: int):
     assert Sq % block_q == 0 and Skv % block_kv == 0, (Sq, Skv, block_q, block_kv)
     grid = (B, H, Sq // block_q, Skv // block_kv)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=1.0 / math.sqrt(D),
                           causal=causal, block_q=block_q, block_kv=block_kv),
         grid=grid,
@@ -106,9 +109,15 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_kv: int):
             pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # m
             pltpu.VMEM((block_q, 128), jnp.float32),   # l
@@ -118,7 +127,159 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_kv: int):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 style): dq pass over kv blocks; dk/dv
+# pass over q blocks. Residuals: q,k,v,o + the forward logsumexp rows.
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, sm_scale, causal, block_q, block_kv):
+    qi = pl.program_id(2)
+    kvi = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(kvi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = kvi * block_kv <= (qi + 1) * block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :1]               # [bq, 1]
+        delta = delta_ref[0, 0, :, :1]           # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kvi * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                     # [bq, bkv]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+
+    @pl.when(kvi == n_kv - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                sm_scale, causal, block_q, block_kv):
+    kvi = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (qi + 1) * block_q - 1 >= kvi * block_kv
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :1]               # [bq, 1]
+        delta = delta_ref[0, 0, :, :1]           # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kvi * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                     # [bq, bkv]
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
+    """q,k,v,o,g: [B,S,H,D] (kv already GQA-expanded); lse: [B,H,Sq]."""
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_kv = min(block_kv, Skv)
+    sm_scale = 1.0 / math.sqrt(D)
+    # delta_i = rowsum(dO * O): cheap elementwise+reduce, fused by XLA;
+    # broadcast over LSE_LANES to match the kernel's tile layout.
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LSE_LANES))
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+
+    qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h, j, 0))
+    lspec = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(B, H, Sq // block_q, Skv // block_kv),
+        in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv pass: kv blocks outer (parallel), q blocks inner (accumulated).
+    qspec2 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kspec2 = pl.BlockSpec((1, 1, block_kv, D), lambda b, h, j, i: (b, h, j, 0))
+    lspec2 = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                          lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(B, H, Skv // block_kv, Sq // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, lspec2, lspec2],
+        out_specs=(kspec2, kspec2),
+        out_shape=(jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_kv, D), jnp.float32),
+                        pltpu.VMEM((block_kv, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt, dot, lse, delta)
+
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -127,28 +288,38 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_kv: int = DEFAULT_BLOCK_KV):
     """Flash attention with the XLA oracle's exact semantics.
 
-    [B, S, H, D] layout; fp32 softmax; GQA via fewer KV heads.
+    [B, S, H, D] layout; fp32 softmax; GQA via fewer KV heads. Forward and
+    backward are both Pallas kernels (FlashAttention-2 recomputation scheme:
+    residuals are q/k/v/o + per-row logsumexp, never the S x S matrix).
     """
-    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                      block_kv=block_kv)
+    k = attn_lib._repeat_kv(k, q.shape[2])
+    v = attn_lib._repeat_kv(v, q.shape[2])
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_kv=block_kv)
+    return out
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_kv):
-    out = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
-    return out, (q, k, v)
+    ke = attn_lib._repeat_kv(k, q.shape[2])
+    ve = attn_lib._repeat_kv(v, q.shape[2])
+    out, lse = _flash_fwd(q, ke, ve, causal=causal, block_q=block_q,
+                          block_kv=block_kv)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_kv, res, g):
-    # Recompute-based backward (XLA): one extra forward's worth of FLOPs,
-    # standard flash-attention practice; Pallas dq/dkv kernels are the
-    # planned replacement for long-sequence memory.
-    q, k, v = res
-
-    def ref(q, k, v):
-        return attn_lib.dot_product_attention(q, k, v, causal=causal)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    H, Hkv = q.shape[2], k.shape[2]
+    ke = attn_lib._repeat_kv(k, H)
+    ve = attn_lib._repeat_kv(v, H)
+    dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
+                            block_q=block_q, block_kv=block_kv)
+    if Hkv != H:
+        # GQA: fold the repeated-head grads back onto the shared KV heads.
+        B, S, _, D = dk.shape
+        dk = dk.reshape(B, S, Hkv, H // Hkv, D).sum(3)
+        dv = dv.reshape(B, S, Hkv, H // Hkv, D).sum(3)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

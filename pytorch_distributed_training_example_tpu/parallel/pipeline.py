@@ -69,6 +69,12 @@ def pipeline_apply(
     x_mb = x.reshape(M, mb, *x.shape[1:])
 
     def per_stage(params_local, x_mb):
+        # Stage fns may run model code containing global sharding
+        # constraints; inside shard_map those don't apply.
+        with mesh_lib.no_constrain():
+            return _per_stage_body(params_local, x_mb)
+
+    def _per_stage_body(params_local, x_mb):
         # shard_map gives the local stage slice with leading dim 1: drop it.
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params_local)
         stage = jax.lax.axis_index(axis)

@@ -154,12 +154,16 @@ def strategy_rules(strategy: str, model_rules: dict[str, Sequence[Rule]] | None 
 
     ``model_rules`` lets a model family contribute TP tables (e.g. Megatron
     column/row splits for attention and MLP); generic strategies need none.
+    A ``_sp`` suffix (Megatron sequence parallelism) and a ``pp`` strategy
+    reuse the family's TP table — SP changes activation constraints and PP
+    changes the step schedule, not the parameter sharding.
     """
     model_rules = model_rules or {}
-    if strategy in model_rules:
-        return tuple(model_rules[strategy])
-    if strategy in ("dp", "ddp", "none"):
+    base = strategy.removesuffix("_sp")
+    if base in model_rules:
+        return tuple(model_rules[base])
+    if base in ("dp", "ddp", "none"):
         return DP_RULES
-    if strategy in ("fsdp", "zero3"):
+    if base in ("fsdp", "zero3", "pp"):
         return FSDP_RULES
     raise ValueError(f"unknown strategy {strategy!r} (model provides {sorted(model_rules)})")

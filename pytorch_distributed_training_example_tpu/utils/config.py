@@ -33,6 +33,7 @@ class Config:
     # precision / memory
     precision: str = "bf16"
     remat: bool = False  # gradient checkpointing (reference configs[4])
+    pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)
     strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)
     mesh_data: int = -1

@@ -100,3 +100,17 @@ def test_ring_and_ulysses_with_tp_heads(devices):
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(ul),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gqa_grads_interpret():
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v = _qkv(S=64, H=4, Hkv=2)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(lambda *a: F.flash_attention(*a, True, 32, 32).sum(),
+                         argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
