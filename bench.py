@@ -126,6 +126,147 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
     }
 
 
+def _synthetic_jpeg_tree(root: str, num_images: int = 256, classes: int = 8,
+                         size=(500, 375)) -> str:
+    """Write an ImageNet-shaped JPEG tree (typical ~500x375 images) once."""
+    import os
+
+    import numpy as np
+    from PIL import Image
+
+    marker = os.path.join(root, f".complete_{num_images}_{size[0]}")
+    if os.path.exists(marker):
+        return root
+    rng = np.random.default_rng(0)
+    w, h = size
+    for i in range(num_images):
+        cdir = os.path.join(root, f"class_{i % classes:03d}")
+        os.makedirs(cdir, exist_ok=True)
+        yy, xx = np.mgrid[0:h, 0:w]
+        base = np.stack([(xx + i * 7) % 256, (yy + i * 13) % 256,
+                         np.full_like(xx, (i * 29) % 256)], -1)
+        arr = np.clip(base + rng.normal(0, 8, base.shape), 0, 255).astype("uint8")
+        Image.fromarray(arr).save(os.path.join(cdir, f"img_{i:05d}.jpg"),
+                                  quality=90)
+    open(marker, "w").close()
+    return root
+
+
+def bench_input(data_path: str | None, image_size: int = 224,
+                batch_size: int = 128, batches: int = 8, workers: int = 8,
+                native: bool = True):
+    """Input pipeline alone: decode+augment+collate images/sec on this host."""
+    import os
+
+    from pytorch_distributed_training_example_tpu.data import (
+        datasets as ds_lib, loader as loader_lib, native_loader,
+        sampler as sampler_lib)
+
+    if not data_path:
+        data_path = _synthetic_jpeg_tree("/tmp/bench_jpeg_tree",
+                                         num_images=max(256, 2 * batch_size))
+    ds = ds_lib.build_dataset("imagenet", data_path, train=True,
+                              image_size=image_size)
+    n_batches = min(batches, len(ds) // batch_size)
+    if n_batches < 2:
+        raise ValueError(
+            f"dataset at {data_path!r} has {len(ds)} images; need at least "
+            f"2*batch_size={2 * batch_size} to measure input throughput")
+    sampler = sampler_lib.ShardedSampler(len(ds), shuffle=True, drop_last=True)
+    dl = loader_lib.build_image_loader(ds, sampler, batch_size,
+                                       workers=workers, native=native)
+    use_native = isinstance(dl, native_loader.NativeDataLoader)
+    it = iter(dl)
+    next(it)  # warm: thread spin-up, first-touch page faults
+    t0 = time.perf_counter()
+    n = 0
+    for b in it:
+        n += len(b["label"])
+        if n >= (n_batches - 1) * batch_size:
+            break
+    dt = time.perf_counter() - t0
+    out = {"input_images_per_sec": round(n / dt, 1),
+           "input_loader": "native_jpeg" if use_native else "python",
+           "input_workers": workers,
+           "host_cpus": os.cpu_count()}
+    if use_native:
+        out["input_decode_errors"] = dl.engine.decode_errors()
+    return out
+
+
+def bench_e2e(data_path: str | None, image_size: int = 224,
+              per_chip_batch: int = 128, steps: int = 8,
+              precision: str = "bf16", workers: int = 8):
+    """End-to-end: real JPEG loader -> device_put -> compiled train step.
+
+    The number SURVEY.md §7(a) asks for: throughput INCLUDING the input
+    pipeline, vs the device-only number the headline measures.
+    """
+    import jax
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib, optim, precision as precision_lib, train_loop)
+    from pytorch_distributed_training_example_tpu.data import (
+        datasets as ds_lib, loader as loader_lib, prefetch,
+        sampler as sampler_lib)
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.parallel import (
+        sharding as sharding_lib)
+    from pytorch_distributed_training_example_tpu.utils.config import from_preset
+
+    mesh = mesh_lib.build_mesh({"data": -1})
+    global_batch = per_chip_batch * mesh_lib.dp_size(mesh)
+    if not data_path:
+        data_path = _synthetic_jpeg_tree("/tmp/bench_jpeg_tree",
+                                         num_images=max(256, 2 * global_batch))
+    cfg = from_preset("resnet50_imagenet", global_batch_size=global_batch,
+                      precision=precision)
+    policy = precision_lib.get_policy(cfg.precision)
+    bundle = registry.create_model("resnet50", num_classes=cfg.num_classes,
+                                   image_size=image_size,
+                                   dtype=policy.compute_dtype,
+                                   param_dtype=policy.param_dtype)
+    tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
+    rules = sharding_lib.strategy_rules("dp", bundle.rules)
+    state = train_loop.create_train_state(bundle.module, tx,
+                                          bundle.input_template, mesh, rules,
+                                          seed=0)
+    step = jax.jit(train_loop.make_train_step(train_loop.get_task(bundle.task)),
+                   donate_argnums=0)
+
+    ds = ds_lib.build_dataset("imagenet", data_path, train=True,
+                              image_size=image_size)
+    if len(ds) < global_batch:
+        raise ValueError(
+            f"dataset at {data_path!r} has {len(ds)} images < global batch "
+            f"{global_batch}; point --data-path at a larger tree")
+    sampler = sampler_lib.ShardedSampler(len(ds), shuffle=True, drop_last=True)
+    dl = loader_lib.build_image_loader(ds, sampler, global_batch,
+                                       workers=workers)
+    total = steps + 2
+    t0 = None
+    n = 0
+    done = 0
+    with mesh_lib.use_mesh(mesh):
+        while done < total:
+            dl.set_epoch(done)  # cycle epochs if the tree is small
+            for batch in prefetch.device_prefetch(
+                    dl, mesh_lib.batch_sharding(mesh)):
+                state, metrics = step(state, batch)
+                done += 1
+                if done == 2:  # past compile + warmup
+                    jax.tree.map(lambda x: x.block_until_ready(), metrics)
+                    t0 = time.perf_counter()
+                elif done > 2:
+                    n += global_batch
+                if done >= total:
+                    break
+        jax.tree.map(lambda x: x.block_until_ready(), metrics)
+    dt = time.perf_counter() - t0
+    return {"e2e_images_per_sec_per_chip": round(n / dt / mesh.size, 1),
+            "e2e_global_batch": global_batch}
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50")
@@ -137,12 +278,24 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--strategy", default=None)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--include-input", action="store_true",
+                   help="also measure loader-only and end-to-end throughput "
+                        "over a real JPEG tree (synthetic if no --data-path)")
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--workers", type=int, default=8)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
     result = bench(args.model, args.image_size, args.per_chip_batch,
                    args.steps, args.warmup, args.precision,
                    quiet=not args.verbose, seq_len=args.seq_len,
                    strategy=args.strategy, remat=args.remat)
+    if args.include_input:
+        result["extra"].update(bench_input(
+            args.data_path, args.image_size, args.per_chip_batch,
+            workers=args.workers))
+        result["extra"].update(bench_e2e(
+            args.data_path, args.image_size, args.per_chip_batch,
+            precision=args.precision, workers=args.workers))
     print(json.dumps(result))
     return 0
 

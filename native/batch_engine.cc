@@ -8,25 +8,39 @@
 // buffers (which Python hands to jax.device_put — the host->HBM copy then
 // overlaps compute via async dispatch).
 //
-// Two dataset modes:
+// Three dataset modes:
 //   - image mode: uint8 [N,H,W,C] source; per-sample ops are reflect-pad-4 +
 //     random crop + horizontal flip (CIFAR recipe) and mean/std normalize to
 //     float32 NHWC.
 //   - gather mode: raw row gather of fixed-size samples (token sequences,
 //     pre-processed float images) with no transform.
+//   - jpeg mode (HAVE_LIBJPEG): ImageNet-style file decode. Per sample:
+//     read JPEG from disk, RandomResizedCrop (train) or resize-short/center
+//     crop (eval) computed in original coords, DCT-space scaled decode
+//     (libjpeg scale_num/8 chosen so the crop decodes at >= out_size),
+//     bilinear crop+resize to [S,S,3], optional hflip, mean/std normalize to
+//     float32. Same pipeline as data/datasets.py:FolderDataset, GIL-free.
 //
-// Build: g++ -O3 -march=native -shared -fPIC -o libbatch_engine.so batch_engine.cc -lpthread
+// Build: make (links -ljpeg when /usr/include/jpeglib.h exists).
 // Driven from Python via ctypes (data/native_loader.py). Plain C ABI.
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
+
+#ifdef HAVE_LIBJPEG
+#include <csetjmp>
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -45,6 +59,12 @@ static inline uint64_t mix(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// mix-based uniform double in [0, 1); advances the state.
+static inline double next_uniform(uint64_t& state) {
+  state = mix(state);
+  return static_cast<double>(state >> 11) * (1.0 / 9007199254740992.0);
+}
+
 struct Engine {
   // dataset description
   const uint8_t* u8_data = nullptr;    // image mode
@@ -54,6 +74,12 @@ struct Engine {
   float mean[8] = {0}, stdinv[8] = {1, 1, 1, 1, 1, 1, 1, 1};
   bool augment = false;
   int pad = 4;
+
+  // jpeg mode
+  bool jpeg_mode = false;
+  std::vector<std::string> paths;
+  int64_t out_size = 0;
+  std::atomic<int64_t> decode_errors{0};
 
   // worker pool
   std::vector<std::thread> workers;
@@ -85,9 +111,192 @@ struct Engine {
   }
 
   void run(const Job& job) {
-    if (u8_data) run_image(job);
+    if (jpeg_mode) run_jpeg(job);
+    else if (u8_data) run_image(job);
     else run_gather(job);
   }
+
+  void run_jpeg(const Job& job) {
+#ifdef HAVE_LIBJPEG
+    float* out = static_cast<float*>(job.out);
+    const int64_t sample = out_size * out_size * 3;
+    for (size_t i = 0; i < job.indices.size(); ++i) {
+      uint64_t rng = job.seed ^ (0x517cc1b7ULL * (i + 1));
+      if (!decode_jpeg(paths[job.indices[i]], out + i * sample, rng)) {
+        // Failed decode: emit the dataset mean (zeros after normalize) so the
+        // batch shape stays valid; count it for the caller to inspect.
+        std::memset(out + i * sample, 0, sample * sizeof(float));
+        decode_errors.fetch_add(1);
+      }
+    }
+#else
+    (void)job;
+#endif
+  }
+
+#ifdef HAVE_LIBJPEG
+  struct JpegErr {
+    jpeg_error_mgr mgr;
+    std::jmp_buf env;
+  };
+
+  static void jpeg_err_exit(j_common_ptr cinfo) {
+    std::longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->env, 1);
+  }
+
+  // Crop box (x, y, w, h, flip) in ORIGINAL pixel coords; mirrors
+  // datasets.py random_resized_crop_params / center_crop_box (the RNG stream
+  // differs by design, as in image mode).
+  void crop_box(uint64_t& rng, int W, int H, double* bx, double* by,
+                double* bw, double* bh, bool* flip) const {
+    if (augment) {
+      const double area = static_cast<double>(W) * H;
+      const double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
+      for (int t = 0; t < 10; ++t) {
+        double target = area * (0.08 + 0.92 * next_uniform(rng));
+        double aspect = std::exp(log_lo + (log_hi - log_lo) * next_uniform(rng));
+        int w = static_cast<int>(std::lround(std::sqrt(target * aspect)));
+        int h = static_cast<int>(std::lround(std::sqrt(target / aspect)));
+        if (w > 0 && w <= W && h > 0 && h <= H) {
+          *bx = static_cast<int>(next_uniform(rng) * (W - w + 1));
+          *by = static_cast<int>(next_uniform(rng) * (H - h + 1));
+          *bw = w;
+          *bh = h;
+          *flip = next_uniform(rng) < 0.5;
+          return;
+        }
+      }
+      double in_ratio = static_cast<double>(W) / H;
+      int w = W, h = H;
+      if (in_ratio < 3.0 / 4.0) h = static_cast<int>(std::lround(W / (3.0 / 4.0)));
+      else if (in_ratio > 4.0 / 3.0) w = static_cast<int>(std::lround(H * (4.0 / 3.0)));
+      *bx = (W - w) / 2;
+      *by = (H - h) / 2;
+      *bw = w;
+      *bh = h;
+      *flip = next_uniform(rng) < 0.5;
+    } else {
+      const int resize_short = static_cast<int>(out_size) * 256 / 224;
+      int short_side = W < H ? W : H;
+      int side = static_cast<int>(std::lround(
+          static_cast<double>(short_side) * out_size / resize_short));
+      if (side < 1) side = 1;
+      *bx = (W - side) / 2;
+      *by = (H - side) / 2;
+      *bw = side;
+      *bh = side;
+      *flip = false;
+    }
+  }
+
+  bool decode_jpeg(const std::string& path, float* dst, uint64_t rng) const {
+    // Read the file into memory (JPEGs are small; avoids stdio src locking).
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long fsize = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (fsize <= 0) {
+      std::fclose(f);
+      return false;
+    }
+    std::vector<uint8_t> buf(static_cast<size_t>(fsize));
+    size_t got = std::fread(buf.data(), 1, buf.size(), f);
+    std::fclose(f);
+    if (got != buf.size()) return false;
+
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    std::vector<uint8_t> pixels;
+    if (setjmp(jerr.env)) {
+      jpeg_destroy_decompress(&cinfo);
+      return false;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, buf.data(), buf.size());
+    jpeg_read_header(&cinfo, TRUE);
+    const int W0 = cinfo.image_width, H0 = cinfo.image_height;
+    if (W0 < 1 || H0 < 1) {
+      jpeg_destroy_decompress(&cinfo);
+      return false;
+    }
+
+    double bx, by, bw, bh;
+    bool flip;
+    crop_box(rng, W0, H0, &bx, &by, &bw, &bh, &flip);
+
+    // DCT-space downscale m/8: smallest m with crop decoding >= out_size.
+    double crop_min = bw < bh ? bw : bh;
+    int m = static_cast<int>(std::ceil(8.0 * out_size / crop_min));
+    if (m < 1) m = 1;
+    if (m > 8) m = 8;
+    cinfo.scale_num = m;
+    cinfo.scale_denom = 8;
+    if (cinfo.jpeg_color_space != JCS_CMYK &&
+        cinfo.jpeg_color_space != JCS_YCCK) {
+      cinfo.out_color_space = JCS_RGB;  // YCbCr/grayscale -> RGB in-library
+    }
+    jpeg_start_decompress(&cinfo);
+    const int Wd = cinfo.output_width, Hd = cinfo.output_height;
+    const int comp = cinfo.output_components;
+    const bool cmyk_inverted = cinfo.saw_Adobe_marker != 0;
+    pixels.resize(static_cast<size_t>(Wd) * Hd * comp);
+    while (cinfo.output_scanline < cinfo.output_height) {
+      JSAMPROW row = pixels.data() + static_cast<size_t>(cinfo.output_scanline) * Wd * comp;
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+
+    // Bilinear sample the crop box (scaled to decoded coords) to SxS.
+    const double sx = static_cast<double>(Wd) / W0;
+    const double sy = static_cast<double>(Hd) / H0;
+    const double x0 = bx * sx, y0 = by * sy;
+    const double step_x = bw * sx / out_size, step_y = bh * sy / out_size;
+    const int S = static_cast<int>(out_size);
+    for (int oy = 0; oy < S; ++oy) {
+      double fy = y0 + (oy + 0.5) * step_y - 0.5;
+      int iy = static_cast<int>(std::floor(fy));
+      double wy = fy - iy;
+      int y1c = iy < 0 ? 0 : (iy >= Hd ? Hd - 1 : iy);
+      int y2c = iy + 1 < 0 ? 0 : (iy + 1 >= Hd ? Hd - 1 : iy + 1);
+      for (int ox = 0; ox < S; ++ox) {
+        double fx = x0 + (ox + 0.5) * step_x - 0.5;
+        int ix = static_cast<int>(std::floor(fx));
+        double wx = fx - ix;
+        int x1c = ix < 0 ? 0 : (ix >= Wd ? Wd - 1 : ix);
+        int x2c = ix + 1 < 0 ? 0 : (ix + 1 >= Wd ? Wd - 1 : ix + 1);
+        const uint8_t* p11 = &pixels[(static_cast<size_t>(y1c) * Wd + x1c) * comp];
+        const uint8_t* p12 = &pixels[(static_cast<size_t>(y1c) * Wd + x2c) * comp];
+        const uint8_t* p21 = &pixels[(static_cast<size_t>(y2c) * Wd + x1c) * comp];
+        const uint8_t* p22 = &pixels[(static_cast<size_t>(y2c) * Wd + x2c) * comp];
+        float rgb[3];
+        for (int c = 0; c < 3; ++c) {
+          int cc = comp >= 3 ? c : 0;
+          double v = (1 - wy) * ((1 - wx) * p11[cc] + wx * p12[cc]) +
+                     wy * ((1 - wx) * p21[cc] + wx * p22[cc]);
+          if (comp == 4) {
+            // CMYK -> RGB: R = (255-C)*(255-K)/255. Adobe JPEGs store the
+            // planes pre-inverted, in which case R = C*K/255 directly.
+            double k = (1 - wy) * ((1 - wx) * p11[3] + wx * p12[3]) +
+                       wy * ((1 - wx) * p21[3] + wx * p22[3]);
+            v = cmyk_inverted ? v * k / 255.0
+                              : (255.0 - v) * (255.0 - k) / 255.0;
+          }
+          rgb[c] = static_cast<float>(v);
+        }
+        int tx = flip ? S - 1 - ox : ox;
+        float* q = dst + (static_cast<size_t>(oy) * S + tx) * 3;
+        for (int c = 0; c < 3; ++c) {
+          q[c] = (rgb[c] * (1.0f / 255.0f) - mean[c]) * stdinv[c];
+        }
+      }
+    }
+    return true;
+  }
+#endif  // HAVE_LIBJPEG
 
   void run_gather(const Job& job) {
     uint8_t* out = static_cast<uint8_t*>(job.out);
@@ -169,6 +378,42 @@ void* be_create_gather(const uint8_t* data, int64_t n, int64_t sample_bytes,
   for (int i = 0; i < num_threads; ++i)
     e->workers.emplace_back([e] { e->worker_loop(); });
   return e;
+}
+
+// JPEG-file mode: `paths_blob` is n concatenated utf-8 paths delimited by
+// `offsets` (n+1 entries). Returns nullptr when built without libjpeg.
+void* be_create_jpeg(const char* paths_blob, const int64_t* offsets, int64_t n,
+                     int64_t out_size, const float* mean, const float* std_,
+                     int augment, int num_threads) {
+#ifdef HAVE_LIBJPEG
+  Engine* e = new Engine();
+  e->jpeg_mode = true;
+  e->n = n;
+  e->out_size = out_size;
+  e->paths.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    e->paths.emplace_back(paths_blob + offsets[i],
+                          static_cast<size_t>(offsets[i + 1] - offsets[i]));
+  }
+  for (int i = 0; i < 3; ++i) {
+    e->mean[i] = mean[i];
+    e->stdinv[i] = 1.0f / std_[i];
+  }
+  e->augment = augment != 0;
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i)
+    e->workers.emplace_back([e] { e->worker_loop(); });
+  return e;
+#else
+  (void)paths_blob; (void)offsets; (void)n; (void)out_size;
+  (void)mean; (void)std_; (void)augment; (void)num_threads;
+  return nullptr;
+#endif
+}
+
+// Decode failures since creation (jpeg mode); failed samples are zero-filled.
+int64_t be_decode_errors(void* handle) {
+  return static_cast<Engine*>(handle)->decode_errors.load();
 }
 
 // Submit one batch: gather `count` samples by `indices` into `out`.

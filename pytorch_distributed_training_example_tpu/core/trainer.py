@@ -132,21 +132,17 @@ class Trainer:
                  dict(self.mesh.shape), cfg.strategy, cfg.precision)
 
     def _make_train_loader(self, sampler):
-        """Prefer the C++ batch engine for uint8 array-backed image datasets."""
+        """Prefer the C++ batch engine: in-memory uint8 arrays (CIFAR) and
+        JPEG directory trees (ImageNet) both have native fast paths."""
         cfg = self.cfg
-        if cfg.native_loader and hasattr(self.train_data, "images_u8"):
-            from pytorch_distributed_training_example_tpu.data import (
-                datasets as ds, native_loader)
+        ldr = loader_lib.build_image_loader(
+            self.train_data, sampler, self.local_batch, workers=cfg.workers,
+            native=cfg.native_loader)
+        from pytorch_distributed_training_example_tpu.data import native_loader
 
-            if native_loader.available():
-                log.info("using native C++ batch engine for the input pipeline")
-                return native_loader.NativeDataLoader(
-                    self.train_data.images_u8, self.train_data.labels, sampler,
-                    self.local_batch, ds.CIFAR_MEAN, ds.CIFAR_STD,
-                    augment=getattr(self.train_data, "augment", False),
-                    num_threads=max(cfg.workers, 1))
-        return loader_lib.DataLoader(self.train_data, self.local_batch, sampler,
-                                     num_workers=cfg.workers)
+        if isinstance(ldr, native_loader.NativeDataLoader):
+            log.info("using native C++ batch engine for the input pipeline")
+        return ldr
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -209,6 +205,11 @@ class Trainer:
             self._train_epoch_inner(epoch, it, loss_m, tput, t_step, watchdog)
         finally:
             watchdog.stop()
+            errs = getattr(getattr(self.train_loader, "engine", None),
+                           "decode_errors", None)
+            if errs is not None and errs() > 0:
+                log.warning("native loader: %d image(s) failed to decode "
+                            "(zero-filled)", errs())
 
     def _train_epoch_inner(self, epoch, it, loss_m, tput, t_step, watchdog):
         cfg = self.cfg

@@ -35,6 +35,37 @@ def collate(samples: list[dict]) -> dict[str, np.ndarray]:
     return out
 
 
+def build_image_loader(dataset, sampler, batch_size: int, workers: int = 0,
+                       native: bool = True):
+    """Pick the fastest available train loader for an image dataset.
+
+    One decision point shared by the trainer and the benchmarks: the native
+    C++ engine serves in-memory uint8 arrays (``images_u8``, CIFAR) and
+    all-JPEG directory trees (``jpeg_paths``, ImageNet); everything else —
+    including trees with non-JPEG files, which the native decoder would
+    zero-fill — falls back to the Python :class:`DataLoader`.
+    """
+    from pytorch_distributed_training_example_tpu.data import native_loader
+
+    augment = bool(getattr(dataset, "augment", False))
+    if native and native_loader.available():
+        if hasattr(dataset, "images_u8"):
+            return native_loader.NativeDataLoader(
+                dataset.images_u8, dataset.labels, sampler, batch_size,
+                dataset.mean, dataset.std, augment=augment,
+                num_threads=max(workers, 1))
+        paths = getattr(dataset, "jpeg_paths", None)
+        if paths and all(p.lower().endswith((".jpg", ".jpeg")) for p in paths):
+            try:
+                return native_loader.NativeDataLoader.jpeg(
+                    paths, dataset.labels, sampler, batch_size,
+                    dataset.image_size, dataset.mean, dataset.std,
+                    augment=augment, num_threads=max(workers, 1))
+            except RuntimeError:  # engine built without libjpeg
+                pass
+    return DataLoader(dataset, batch_size, sampler, num_workers=workers)
+
+
 class DataLoader:
     """Iterates per-host batches of stacked numpy arrays.
 

@@ -50,6 +50,13 @@ def _load() -> ctypes.CDLL | None:
         lib.be_create_gather.restype = ctypes.c_void_p
         lib.be_create_gather.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                          ctypes.c_int64, ctypes.c_int]
+        lib.be_create_jpeg.restype = ctypes.c_void_p
+        lib.be_create_jpeg.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
+        lib.be_decode_errors.restype = ctypes.c_int64
+        lib.be_decode_errors.argtypes = [ctypes.c_void_p]
         lib.be_submit.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                   ctypes.POINTER(ctypes.c_int64),
                                   ctypes.c_int64, ctypes.c_void_p,
@@ -90,6 +97,33 @@ class NativeBatchEngine:
         eng = cls(handle, lib, (h, w, c), np.float32)
         eng._keepalive.append(data_u8)
         return eng
+
+    @classmethod
+    def jpeg(cls, paths: list, image_size: int, mean, std, augment: bool,
+             num_threads: int = 2) -> "NativeBatchEngine":
+        """File-decode engine (native/batch_engine.cc jpeg mode).
+
+        Raises RuntimeError when the library was built without libjpeg.
+        """
+        lib = _load()
+        assert lib is not None
+        encoded = [p.encode("utf-8") for p in paths]
+        offsets = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(p) for p in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
+        handle = lib.be_create_jpeg(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(encoded), image_size, mean_arr, std_arr, int(augment),
+            num_threads)
+        if not handle:
+            raise RuntimeError("batch engine built without libjpeg support")
+        eng = cls(handle, lib, (image_size, image_size, 3), np.float32)
+        return eng
+
+    def decode_errors(self) -> int:
+        return int(self._lib.be_decode_errors(self._handle))
 
     @classmethod
     def gather(cls, data: np.ndarray, num_threads: int = 2) -> "NativeBatchEngine":
@@ -139,19 +173,29 @@ class NativeDataLoader:
 
     def __init__(self, images_u8, labels, sampler, batch_size: int,
                  mean, std, augment: bool, num_threads: int = 2,
-                 prefetch: int = 4, drop_last: bool = True):
+                 prefetch: int = 4, drop_last: bool = True, engine=None):
         if not drop_last:
             # The engine writes into fixed-size buffers; a short final batch
             # would leave stale tail rows. Use the Python loader for that.
             raise ValueError("NativeDataLoader requires drop_last=True")
-        self.engine = NativeBatchEngine.image(images_u8, mean, std, augment,
-                                              num_threads)
+        self.engine = engine if engine is not None else NativeBatchEngine.image(
+            images_u8, mean, std, augment, num_threads)
         self.labels = np.asarray(labels)
         self.sampler = sampler
         self.batch_size = batch_size
         self.prefetch = prefetch
         self.epoch = 0
         self._next_id = 0  # globally monotonic: ids never reused across epochs
+
+    @classmethod
+    def jpeg(cls, paths: list, labels, sampler, batch_size: int,
+             image_size: int, mean, std, augment: bool, num_threads: int = 2,
+             prefetch: int = 4) -> "NativeDataLoader":
+        """Loader over a FolderDataset's files via the native decode engine."""
+        engine = NativeBatchEngine.jpeg(paths, image_size, mean, std, augment,
+                                        num_threads)
+        return cls(None, labels, sampler, batch_size, None, None, augment,
+                   num_threads, prefetch, engine=engine)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch

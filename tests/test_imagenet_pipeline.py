@@ -1,0 +1,239 @@
+"""Real-ImageNet input pipeline: FolderDataset + native JPEG decode.
+
+SURVEY.md §2a #3 / §7 hard part (a): the reference's ImageNet path is
+ImageFolder + RandomResizedCrop/flip (train), Resize/CenterCrop (eval).
+These tests run on a synthetic class-per-directory JPEG tree.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.data import native_loader
+from pytorch_distributed_training_example_tpu.data.datasets import (
+    IMAGENET_MEAN, IMAGENET_STD, FolderDataset, build_dataset,
+    center_crop_box, random_resized_crop_params)
+from pytorch_distributed_training_example_tpu.data.loader import DataLoader
+from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
+
+
+def _write_jpeg(path, width, height, color=None, seed=0):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    if color is not None:
+        arr = np.tile(np.array(color, np.uint8), (height, width, 1))
+    else:
+        # Smooth gradient + mild noise: JPEG-friendly, resampling-kernel
+        # agnostic (PIL antialiases; the native path is plain bilinear).
+        yy, xx = np.mgrid[0:height, 0:width]
+        base = np.stack([xx * 255 / max(width - 1, 1),
+                         yy * 255 / max(height - 1, 1),
+                         np.full_like(xx, 128)], -1)
+        arr = np.clip(base + rng.normal(0, 3, base.shape), 0, 255).astype(np.uint8)
+    Image.fromarray(arr).save(path, quality=92)
+
+
+@pytest.fixture(scope="module")
+def jpeg_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imagenet_tree")
+    sizes = [(96, 80), (120, 96), (64, 96), (100, 100), (80, 120), (72, 64)]
+    for ci, cls in enumerate(["n01_cat", "n02_dog", "n03_fox"]):
+        (root / cls).mkdir()
+        for i, (w, h) in enumerate(sizes):
+            _write_jpeg(str(root / cls / f"img_{i}.jpg"), w, h,
+                        seed=ci * 100 + i)
+    return str(root)
+
+
+def test_folder_dataset_scan(jpeg_tree):
+    ds = FolderDataset(jpeg_tree, train=False, image_size=32)
+    assert ds.classes == ["n01_cat", "n02_dog", "n03_fox"]
+    assert len(ds) == 18
+    np.testing.assert_array_equal(ds.labels, np.repeat([0, 1, 2], 6))
+
+
+def test_folder_eval_deterministic_and_normalized(jpeg_tree):
+    ds = FolderDataset(jpeg_tree, train=False, image_size=32)
+    a, b = ds[0], ds[0]
+    np.testing.assert_array_equal(a["image"], b["image"])
+    assert a["image"].shape == (32, 32, 3)
+    assert a["image"].dtype == np.float32
+    # Normalized pixel range: (x/255 - mean)/std for x in [0,255].
+    lo = (0.0 - IMAGENET_MEAN) / IMAGENET_STD
+    hi = (1.0 - IMAGENET_MEAN) / IMAGENET_STD
+    assert (a["image"] >= lo - 1e-5).all() and (a["image"] <= hi + 1e-5).all()
+
+
+def test_folder_train_augment_reseeds_per_epoch(jpeg_tree):
+    ds = FolderDataset(jpeg_tree, train=True, image_size=32, seed=3)
+    x0 = ds[1]["image"]
+    x0_again = ds[1]["image"]
+    np.testing.assert_array_equal(x0, x0_again)  # deterministic within epoch
+    ds.epoch = 1
+    x1 = ds[1]["image"]
+    assert np.abs(x0 - x1).max() > 1e-3  # crop moved
+
+
+def test_random_resized_crop_params_in_bounds():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        x, y, w, h = random_resized_crop_params(rng, 120, 90)
+        assert 0 <= x and x + w <= 120 and 0 <= y and y + h <= 90
+        assert w > 0 and h > 0
+
+
+def test_center_crop_box_matches_recipe():
+    # 224 out of resize-short-256: centered square of short*224/256.
+    x, y, w, h = center_crop_box(500, 400, 224)
+    assert w == h == round(400 * 224 / 256)
+    assert x == (500 - w) // 2 and y == (400 - h) // 2
+
+
+def test_eval_decode_color_fidelity(tmp_path):
+    # Flat-color image: any correct decode/crop/resize yields that color.
+    p = tmp_path / "c" / "flat.jpg"
+    p.parent.mkdir()
+    _write_jpeg(str(p), 90, 70, color=(200, 60, 120))
+    ds = FolderDataset(str(tmp_path), train=False, image_size=24)
+    img = ds[0]["image"] * IMAGENET_STD + IMAGENET_MEAN  # un-normalize
+    expect = np.array([200, 60, 120]) / 255.0
+    assert np.abs(img.mean((0, 1)) - expect).max() < 0.03  # JPEG tolerance
+
+
+def test_build_dataset_dispatches_to_folder(jpeg_tree):
+    ds = build_dataset("imagenet", jpeg_tree, train=True, image_size=48)
+    assert isinstance(ds, FolderDataset)
+    assert ds.augment
+    # train/val split layout is preferred when present
+    split_root = os.path.join(jpeg_tree, "..", "split")
+    os.makedirs(os.path.join(split_root, "train", "a"), exist_ok=True)
+    os.makedirs(os.path.join(split_root, "val", "a"), exist_ok=True)
+    _write_jpeg(os.path.join(split_root, "train", "a", "x.jpg"), 40, 40)
+    _write_jpeg(os.path.join(split_root, "val", "a", "y.jpg"), 40, 40)
+    tr = build_dataset("imagenet", split_root, train=True, image_size=32)
+    ev = build_dataset("imagenet", split_root, train=False, image_size=32)
+    assert tr.jpeg_paths[0].endswith("x.jpg")
+    assert ev.jpeg_paths[0].endswith("y.jpg")
+
+
+def test_folder_dataset_with_loader(jpeg_tree):
+    ds = FolderDataset(jpeg_tree, train=True, image_size=32)
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4  # 18 // 4 with drop_last
+    assert batches[0]["image"].shape == (4, 32, 32, 3)
+    assert batches[0]["label"].dtype == np.int32
+
+
+needs_native = pytest.mark.skipif(not native_loader.available(),
+                                  reason="native engine unavailable")
+
+
+@needs_native
+def test_native_jpeg_decode_color_fidelity(tmp_path):
+    p = tmp_path / "c" / "flat.jpg"
+    p.parent.mkdir()
+    _write_jpeg(str(p), 90, 70, color=(30, 180, 90))
+    eng = native_loader.NativeBatchEngine.jpeg(
+        [str(p)], 24, IMAGENET_MEAN, IMAGENET_STD, augment=False,
+        num_threads=1)
+    out = np.empty((1, 24, 24, 3), np.float32)
+    eng.submit(0, np.array([0]), out, seed=0)
+    eng.wait(0)
+    assert eng.decode_errors() == 0
+    img = out[0] * IMAGENET_STD + IMAGENET_MEAN
+    expect = np.array([30, 180, 90]) / 255.0
+    assert np.abs(img.mean((0, 1)) - expect).max() < 0.03
+    eng.close()
+
+
+@needs_native
+def test_native_jpeg_eval_close_to_pil(jpeg_tree):
+    """Native bilinear vs PIL (antialiased) on smooth images: close, not equal."""
+    ds = FolderDataset(jpeg_tree, train=False, image_size=32)
+    eng = native_loader.NativeBatchEngine.jpeg(
+        ds.jpeg_paths, 32, IMAGENET_MEAN, IMAGENET_STD, augment=False,
+        num_threads=2)
+    idx = np.arange(6)
+    out = np.empty((6, 32, 32, 3), np.float32)
+    eng.submit(0, idx, out, seed=0)
+    eng.wait(0)
+    assert eng.decode_errors() == 0
+    ref = np.stack([ds[int(i)]["image"] for i in idx])
+    assert np.abs(out - ref).mean() < 0.08  # normalized units (std ~0.225)
+    eng.close()
+
+
+@needs_native
+def test_native_jpeg_loader_end_to_end(jpeg_tree):
+    ds = FolderDataset(jpeg_tree, train=True, image_size=32, seed=0)
+    sampler = ShardedSampler(len(ds), shuffle=True, seed=0, drop_last=True)
+    dl = native_loader.NativeDataLoader.jpeg(
+        ds.jpeg_paths, ds.labels, sampler, batch_size=4, image_size=32,
+        mean=IMAGENET_MEAN, std=IMAGENET_STD, augment=True, num_threads=2)
+    dl.set_epoch(0)
+    batches = list(dl)
+    assert len(batches) == 4
+    for b in batches:
+        assert b["image"].shape == (4, 32, 32, 3)
+        assert np.isfinite(b["image"]).all()
+    assert dl.engine.decode_errors() == 0
+    # labels follow the sampler's index order
+    order = sampler.local_indices()[:4]
+    np.testing.assert_array_equal(batches[0]["label"], ds.labels[order])
+
+
+@needs_native
+def test_native_jpeg_decode_error_counted(tmp_path):
+    p = tmp_path / "c"
+    p.mkdir()
+    good = p / "good.jpg"
+    _write_jpeg(str(good), 40, 40, color=(10, 10, 10))
+    bad = p / "bad.jpg"
+    bad.write_bytes(b"not a jpeg at all")
+    eng = native_loader.NativeBatchEngine.jpeg(
+        [str(good), str(bad)], 16, IMAGENET_MEAN, IMAGENET_STD,
+        augment=False, num_threads=1)
+    out = np.full((2, 16, 16, 3), 7.0, np.float32)
+    eng.submit(0, np.array([0, 1]), out, seed=0)
+    eng.wait(0)
+    assert eng.decode_errors() == 1
+    assert np.abs(out[1]).max() == 0.0  # zero-filled, not stale
+    eng.close()
+
+
+def test_resnet_trains_from_jpeg_tree(jpeg_tree, devices):
+    """ResNet-50 takes real optimizer steps fed from a directory tree
+    (driver-metric workload end to end, tiny shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib, optim, train_loop)
+    from pytorch_distributed_training_example_tpu.data import prefetch
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.parallel import (
+        sharding as sharding_lib)
+    from pytorch_distributed_training_example_tpu.utils.config import Config
+
+    mesh = mesh_lib.build_mesh({"data": 8})
+    ds = FolderDataset(jpeg_tree, train=True, image_size=64)
+    dl = DataLoader(ds, batch_size=16, num_workers=2)
+    bundle = registry.create_model("resnet50", num_classes=3, image_size=64,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    tx, _ = optim.build_optimizer(Config(lr=0.01), steps_per_epoch=1)
+    rules = sharding_lib.strategy_rules("dp", bundle.rules)
+    state = train_loop.create_train_state(
+        bundle.module, tx, bundle.input_template, mesh, rules, seed=0)
+    step = jax.jit(train_loop.make_train_step(train_loop.get_task(bundle.task)),
+                   donate_argnums=0)
+    with mesh_lib.use_mesh(mesh):
+        it = prefetch.device_prefetch(dl, mesh_lib.batch_sharding(mesh))
+        for i, batch in enumerate(it):
+            state, metrics = step(state, batch)
+            if i == 0:
+                break
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(jax.device_get(state.step)) == 1
