@@ -163,8 +163,12 @@ def bench_input(data_path: str | None, image_size: int = 224,
         sampler as sampler_lib)
 
     if not data_path:
-        data_path = _synthetic_jpeg_tree("/tmp/bench_jpeg_tree",
-                                         num_images=max(256, 2 * batch_size))
+        # Cover the full measured run: with only ~2 batches on disk, the
+        # prefetcher would decode everything during warmup and the timed
+        # loop would measure buffer copies, not decode throughput.
+        data_path = _synthetic_jpeg_tree(
+            "/tmp/bench_jpeg_tree",
+            num_images=max(256, (batches + 1) * batch_size))
     ds = ds_lib.build_dataset("imagenet", data_path, train=True,
                               image_size=image_size)
     n_batches = min(batches, len(ds) // batch_size)

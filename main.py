@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint dir or 'auto' (newest committed)")
     p.add_argument("--profile-steps", default=None,
                    help="'start:stop' global-step range to trace")
+    p.add_argument("--fault-inject", default=None,
+                   help="'rank:step' — hard-kill that process before the "
+                        "given global step (recovery testing)")
     p.add_argument("--coordinator", default=None,
                    help="coordinator address host:port (else env)")
     p.add_argument("--num-processes", type=int, default=None)

@@ -10,11 +10,22 @@ restore. TPU-native design (Orbax-style, self-contained implementation):
   which index-region, so restore works under a *different* sharding/topology
   than save (regions are assembled, then re-placed by ``device_put`` with the
   target NamedSharding);
-- a ``COMMIT`` marker is written last (after a cross-host barrier), so a
-  crashed half-written checkpoint is never eligible for ``--resume auto``
+- a ``COMMIT`` marker is written last (after every host's files are on disk),
+  so a crashed half-written checkpoint is never eligible for ``--resume auto``
   (partial-write recovery, SURVEY.md §7 hard part (b));
 - file writes run on a background thread (device->host copy is taken
-  synchronously first, since the train loop donates state buffers).
+  synchronously first, since the train loop donates state buffers). The
+  cross-host commit rendezvous is FILESYSTEM-based (process 0 waits for every
+  host's per-host file list to appear) rather than a device collective, so
+  multi-host saves stay async too: a device-collective barrier on a
+  background thread could interleave with train-step collectives and
+  deadlock, and the shared-filesystem assumption is already baked into
+  restore's manifest union;
+- restore assembles each leaf PER ADDRESSABLE SHARD of the target sharding
+  (index-intersecting saved regions with the shard's index) and builds the
+  array via ``jax.make_array_from_single_device_arrays`` — peak host memory
+  is the host's shard bytes, not the full model (required for FSDP restore
+  of models no single host can hold).
 """
 
 from __future__ import annotations
@@ -91,16 +102,20 @@ class Checkpointer:
             }
 
         step_dir = os.path.join(self.directory, f"step_{step:08d}")
-        tmp_dir = step_dir + f".tmp{jax.process_index()}"
-
         multihost = jax.process_count() > 1
-        # Cross-host saves must be synchronous: the commit barrier is a
-        # device collective, and running it on a background thread while the
-        # main thread dispatches train-step collectives can reorder
-        # collective launches across hosts (deadlock). Single-host saves
-        # need no barrier and stay async.
+        nproc = jax.process_count()
+
+        # Re-saving a step that a crashed run half-wrote (train to step N,
+        # die mid-save, resume, reach N again): stale files.p*.json sentinels
+        # would satisfy process 0's commit wait while other hosts are still
+        # rewriting arrays -> corrupt COMMITted checkpoint. Clear the stale
+        # dir first, and barrier ON THE MAIN THREAD (same thread that
+        # dispatches train-step collectives, so no cross-thread collective
+        # interleaving) so no host writes before the cleanup.
+        if distributed.is_main_process() and os.path.isdir(step_dir):
+            shutil.rmtree(step_dir, ignore_errors=True)
         if multihost:
-            block = True
+            distributed.barrier(f"ckpt_clear_{step}")
 
         def write():
             arrays_dir = os.path.join(step_dir, "arrays")
@@ -113,8 +128,17 @@ class Checkpointer:
                     np.save(os.path.join(arrays_dir, fname), data)
                     written.setdefault(path, []).append({"file": fname, "index": idx})
             if multihost:
-                distributed.barrier("ckpt_write")
+                # Per-host file list doubles as the "this host is done"
+                # sentinel: written ATOMICALLY (tmp+rename) after the arrays
+                # so process 0 commits only once every host's data is on the
+                # shared filesystem. No device collective -> async-safe.
+                flist = os.path.join(step_dir, f"files.p{jax.process_index()}.json")
+                with open(flist + ".tmp", "w") as fh:
+                    json.dump({p: f for p, f in written.items()}, fh)
+                os.replace(flist + ".tmp", flist)
             if distributed.is_main_process():
+                if multihost and not self._await_hosts(step_dir, nproc):
+                    return  # a host died mid-save: leave uncommitted
                 manifest = {
                     "step": step,
                     "extra": extra or {},
@@ -123,26 +147,33 @@ class Checkpointer:
                         for p in shards
                     },
                 }
-                # NOTE: multi-host file listings are per-host in `written`;
-                # each host also drops its own files manifest for restore-time
-                # union (hosts may write to a shared filesystem).
+                # NOTE: multi-host file listings are per-host in files.p*.json;
+                # restore unions them with the manifest's own list.
                 with open(os.path.join(step_dir, MANIFEST_FILE), "w") as fh:
                     json.dump(manifest, fh)
-            if multihost:
-                with open(os.path.join(step_dir, f"files.p{jax.process_index()}.json"), "w") as fh:
-                    json.dump({p: f for p, f in written.items()}, fh)
-                distributed.barrier("ckpt_manifest")
-            if distributed.is_main_process():
                 with open(os.path.join(step_dir, COMMIT_FILE), "w") as fh:
                     fh.write(str(step))
                 self._prune()
 
-        del tmp_dir  # single dir + COMMIT marker is the atomicity boundary
+        # single dir + COMMIT marker is the atomicity boundary
         if block:
             write()
         else:
             self._thread = threading.Thread(target=write, daemon=True)
             self._thread.start()
+
+    def _await_hosts(self, step_dir: str, nproc: int,
+                     timeout_s: float = 600.0) -> bool:
+        """Wait for every host's files.p*.json sentinel; False on timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        want = {f"files.p{i}.json" for i in range(nproc)}
+        while time.monotonic() < deadline:
+            if want <= set(os.listdir(step_dir)):
+                return True
+            time.sleep(0.05)
+        return False
 
     def wait(self):
         if self._thread is not None:
@@ -185,20 +216,11 @@ class Checkpointer:
             if path not in flat_template:
                 continue
             target = flat_template[path]
-            full = np.empty(meta["shape"], dtype=np.dtype(meta["dtype"]))
-            for entry in meta["files"]:
-                region = np.load(os.path.join(arrays_dir, entry["file"]))
-                sl = tuple(slice(a, b) for a, b in entry["index"])
-                if full.ndim == 0:
-                    full = region.reshape(())
-                else:
-                    full[sl] = region
-            if hasattr(target, "sharding") and isinstance(target, jax.Array):
-                restored[path] = jax.device_put(full, target.sharding)
-            elif hasattr(target, "sharding"):  # ShapeDtypeStruct with sharding
-                restored[path] = jax.device_put(full, target.sharding)
+            if hasattr(target, "sharding"):
+                restored[path] = _assemble_sharded(
+                    arrays_dir, meta, target.sharding)
             else:
-                restored[path] = full
+                restored[path] = _assemble_full(arrays_dir, meta)
 
         def rebuild(path, x):
             key = param_path(path)
@@ -209,6 +231,63 @@ class Checkpointer:
 
         state = jax.tree_util.tree_map_with_path(rebuild, state_template)
         return state, manifest.get("extra", {})
+
+
+def _assemble_full(arrays_dir: str, meta: dict) -> np.ndarray:
+    """Materialize a whole leaf (host-local numpy targets only)."""
+    full = np.empty(meta["shape"], dtype=np.dtype(meta["dtype"]))
+    for entry in meta["files"]:
+        region = np.load(os.path.join(arrays_dir, entry["file"]))
+        if full.ndim == 0:
+            full = region.reshape(())
+        else:
+            full[tuple(slice(a, b) for a, b in entry["index"])] = region
+    return full
+
+
+def _assemble_sharded(arrays_dir: str, meta: dict, sharding) -> jax.Array:
+    """Build a jax.Array leaf shard-by-shard under the target ``sharding``.
+
+    For every addressable shard of the target, copy in just the overlapping
+    parts of the saved regions (mmap-opened, so only the overlap is read).
+    Peak host memory is one shard, not the leaf — FSDP-restore requirement
+    (SURVEY.md §3.4/§7(b)); also how a checkpoint saved under one topology
+    re-shards onto another.
+    """
+    shape = tuple(meta["shape"])
+    index_map = sharding.addressable_devices_indices_map(shape)
+    opened: dict[str, np.ndarray] = {}
+
+    def region(fname):
+        if fname not in opened:
+            opened[fname] = np.load(os.path.join(arrays_dir, fname),
+                                    mmap_mode="r")
+        return opened[fname]
+
+    pieces = []
+    for device, idx in index_map.items():
+        bounds = [
+            (s.start or 0, s.stop if s.stop is not None else dim)
+            for s, dim in zip(idx, shape)
+        ]
+        block = np.empty([b - a for a, b in bounds],
+                         dtype=np.dtype(meta["dtype"]))
+        for entry in meta["files"]:
+            src = entry["index"] if shape else []
+            inter = [(max(a, c), min(b, d))
+                     for (a, b), (c, d) in zip(bounds, src)]
+            if any(a >= b for a, b in inter):
+                continue
+            dst_sl = tuple(slice(a - o[0], b - o[0])
+                           for (a, b), o in zip(inter, bounds))
+            src_sl = tuple(slice(a - o[0], b - o[0])
+                           for (a, b), o in zip(inter, src))
+            if block.ndim == 0:
+                block = np.asarray(region(entry["file"])).reshape(())
+            else:
+                block[dst_sl] = region(entry["file"])[src_sl]
+        pieces.append(jax.device_put(block, device))
+    return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
 
 
 def all_checkpoints(directory: str) -> list[int]:

@@ -126,6 +126,11 @@ class Trainer:
             a, b = cfg.profile_steps.split(":")
             self.profile_range = (int(a), int(b))
 
+        self.fault_inject = None
+        if cfg.fault_inject:  # "rank:step" — SURVEY.md §5 fault injector
+            r, s = cfg.fault_inject.split(":")
+            self.fault_inject = (int(r), int(s))
+
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state.params))
         log.info("model=%s params=%.2fM devices=%d mesh=%s strategy=%s precision=%s",
                  cfg.model, n_params / 1e6, jax.device_count(),
@@ -219,6 +224,14 @@ class Trainer:
                 if i >= self.steps_per_epoch:
                     break
                 gstep = epoch * self.steps_per_epoch + i
+                if (self.fault_inject
+                        and jax.process_index() == self.fault_inject[0]
+                        and gstep == self.fault_inject[1]):
+                    # Simulated host failure: no cleanup, no flushes — the
+                    # hardest crash shape recovery must handle.
+                    log.error("fault injection: killing process %d at step %d",
+                              *self.fault_inject)
+                    os._exit(57)
                 if self.profile_range and gstep == self.profile_range[0]:
                     jax.profiler.start_trace(cfg.profile_dir)
                 self.state, metrics = self.train_step(self.state, batch)

@@ -75,11 +75,17 @@ def available() -> bool:
 class NativeBatchEngine:
     """Thin RAII wrapper; one engine per (dataset, mode)."""
 
-    def __init__(self, handle, lib, sample_shape, out_dtype):
+    def __init__(self, handle, lib, sample_shape, out_dtype,
+                 num_threads: int = 1, chunked: bool = False):
         self._handle = handle
         self._lib = lib
         self.sample_shape = sample_shape
         self.out_dtype = out_dtype
+        self.num_threads = num_threads
+        # One engine job runs on ONE worker thread; expensive per-sample work
+        # (JPEG decode) must be submitted in per-thread chunks or parallelism
+        # caps at the number of in-flight jobs instead of num_threads.
+        self.chunked = chunked
         self._keepalive = []  # buffers the C++ side reads from
 
     @classmethod
@@ -94,7 +100,7 @@ class NativeBatchEngine:
         handle = lib.be_create_image(
             data_u8.ctypes.data_as(ctypes.c_void_p), n, h, w, c,
             mean_arr, std_arr, int(augment), num_threads)
-        eng = cls(handle, lib, (h, w, c), np.float32)
+        eng = cls(handle, lib, (h, w, c), np.float32, num_threads=num_threads)
         eng._keepalive.append(data_u8)
         return eng
 
@@ -119,8 +125,8 @@ class NativeBatchEngine:
             num_threads)
         if not handle:
             raise RuntimeError("batch engine built without libjpeg support")
-        eng = cls(handle, lib, (image_size, image_size, 3), np.float32)
-        return eng
+        return cls(handle, lib, (image_size, image_size, 3), np.float32,
+                   num_threads=num_threads, chunked=True)
 
     def decode_errors(self) -> int:
         return int(self._lib.be_decode_errors(self._handle))
@@ -134,7 +140,8 @@ class NativeBatchEngine:
         sample_bytes = int(data.nbytes // n)
         handle = lib.be_create_gather(
             data.ctypes.data_as(ctypes.c_void_p), n, sample_bytes, num_threads)
-        eng = cls(handle, lib, data.shape[1:], data.dtype)
+        eng = cls(handle, lib, data.shape[1:], data.dtype,
+                  num_threads=num_threads)
         eng._keepalive.append(data)
         return eng
 
@@ -208,26 +215,39 @@ class NativeDataLoader:
         idx = self.sampler.local_indices()
         nb = len(self)
         h, w, c = self.engine.sample_shape
-        bufs = [np.empty((self.batch_size, h, w, c), np.float32)
+        bufs = [np.empty((self.batch_size, h, w, c), self.engine.out_dtype)
                 for _ in range(self.prefetch)]
-        pending: dict[int, tuple[int, np.ndarray]] = {}  # b -> (id, indices)
+        pending: dict[int, tuple[list[int], np.ndarray]] = {}  # b -> (ids, indices)
+
+        # Expensive per-sample engines (JPEG decode) get the batch split
+        # into one job per worker thread — a single job runs on a single
+        # thread, so batch-granular submission would cap parallelism at the
+        # prefetch depth instead of num_threads.
+        n_chunks = max(self.engine.num_threads, 1) if self.engine.chunked else 1
 
         def submit(b):
             lo = b * self.batch_size
             bi = np.ascontiguousarray(idx[lo:lo + self.batch_size], np.int64)
-            bid = self._next_id
-            self._next_id += 1
-            pending[b] = (bid, bi)  # indices kept alive until wait() returns
-            self.engine.submit(bid, bi, bufs[b % self.prefetch],
-                               seed=(self.epoch << 32) ^ b)
+            buf = bufs[b % self.prefetch]
+            per = -(-len(bi) // min(n_chunks, len(bi)))
+            ids = []
+            for j in range(0, len(bi), per):
+                cid = self._next_id
+                self._next_id += 1
+                self.engine.submit(cid, np.ascontiguousarray(bi[j:j + per]),
+                                   buf[j:],
+                                   seed=(self.epoch << 32) ^ (b << 8) ^ (j // per))
+                ids.append(cid)
+            pending[b] = (ids, bi)
 
         inflight = min(self.prefetch, nb)
         for b in range(inflight):
             submit(b)
         try:
             for b in range(nb):
-                bid, bi = pending[b]
-                self.engine.wait(bid)
+                ids, bi = pending[b]
+                for cid in ids:
+                    self.engine.wait(cid)
                 del pending[b]
                 batch = {"image": bufs[b % self.prefetch].copy(),
                          "label": self.labels[bi].astype(np.int32)}
@@ -238,8 +258,9 @@ class NativeDataLoader:
             # Drain in-flight jobs before `bufs` can be garbage-collected:
             # abandoned C++ jobs hold raw pointers into them (use-after-free
             # otherwise when the consumer stops early).
-            for bid, _ in pending.values():
-                try:
-                    self.engine.wait(bid)
-                except TimeoutError:
-                    pass
+            for ids, _ in pending.values():
+                for cid in ids:
+                    try:
+                        self.engine.wait(cid)
+                    except TimeoutError:
+                        pass

@@ -137,6 +137,20 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, **_):
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
+@register("resnet_micro")
+def _resnet_micro(*, num_classes, image_size, dtype, param_dtype, **_):
+    from pytorch_distributed_training_example_tpu.models import resnet
+
+    module = resnet.resnet_micro(num_classes=num_classes, dtype=dtype,
+                                 param_dtype=param_dtype)
+    return ModelBundle(
+        module=module, task="classification",
+        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
+        fwd_flops_per_example=resnet.flops_per_image("resnet_micro", image_size),
+        rules={},
+    )
+
+
 @register("resnet18")
 def _resnet18(*, num_classes, image_size, dtype, param_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import resnet

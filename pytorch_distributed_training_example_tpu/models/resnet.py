@@ -122,6 +122,20 @@ def resnet18(num_classes: int = 1000, **kw) -> ResNet:
                   num_classes=num_classes, **kw)
 
 
+def resnet_micro(num_classes: int = 10, **kw) -> ResNet:
+    """Two-block ResNet: the test-suite oracle model.
+
+    Exercises every code path the big models do (BN batch_stats sync over
+    the sharded batch, stride-2 downsample projection, AUTO_FSDP conv/dense
+    sharding, activation constraints) at a fraction of the compile time.
+    32 base filters keeps the stage-2 convs (3x3x64x64 = 36.9k elements)
+    above parallel/sharding.py's MIN_SHARD_ELEMENTS so FSDP really shards.
+    """
+    kw.setdefault("small_images", True)
+    return ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_filters=32,
+                  num_classes=num_classes, **kw)
+
+
 def resnet50(num_classes: int = 1000, **kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck,
                   num_classes=num_classes, **kw)
@@ -133,5 +147,5 @@ def flops_per_image(name: str, image_size: int = 224) -> float:
     Standard published figures: ResNet-50 @224 ~= 4.09 GFLOP (multiply-adds
     x2), ResNet-18 @224 ~= 1.81 GFLOP; scaled quadratically for other sizes.
     """
-    base = {"resnet18": 1.81e9, "resnet50": 4.09e9}[name]
+    base = {"resnet18": 1.81e9, "resnet50": 4.09e9, "resnet_micro": 1.2e7}[name]
     return base * (image_size / 224.0) ** 2

@@ -10,10 +10,11 @@ the output block is written once on the last step.
 Causal masking skips fully-masked kv blocks (predicated with ``pl.when``)
 and applies an elementwise mask only on the diagonal block.
 
-Backward currently recomputes attention with XLA inside a ``custom_vjp``
-(correct everywhere, tested vs the oracle); a Pallas dq/dkv kernel pair is
-the planned upgrade. Layout: [B, S, H, D] in, transposed to [B, H, S, D]
-internally (head-major keeps the MXU's 128-lane dim on head_dim).
+Backward is a Pallas dq/dkv kernel pair under ``custom_vjp`` (see
+``_dq_kernel``/``_dkv_kernel`` below): recompute-based, using the
+saved forward LSE, with the same blockwise masking. Layout: [B, S, H, D] in,
+transposed to [B, H, S, D] internally (head-major keeps the MXU's 128-lane
+dim on head_dim).
 """
 
 from __future__ import annotations

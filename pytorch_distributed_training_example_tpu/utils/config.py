@@ -55,6 +55,9 @@ class Config:
     # profiling
     profile_steps: str | None = None  # "start:stop" step range
     profile_dir: str = "/tmp/pdtx_profile"
+    # fault injection (SURVEY.md §5 failure detection): "rank:step" hard-kills
+    # that host process before the given global step — for recovery testing.
+    fault_inject: str | None = None
     # loop control (bench/smoke)
     steps_per_epoch: int | None = None  # cap steps (synthetic/bench runs)
 

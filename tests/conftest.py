@@ -19,6 +19,12 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: XLA:CPU compiles dominate suite wall time
+# (25s -> ~7s for a ResNet-18 train step on re-runs). Machine-local cache in
+# /tmp — never shipped; safe because re-runs happen on the same host.
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/pytorch_distributed_training_example_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(scope="session")

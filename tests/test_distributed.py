@@ -56,6 +56,42 @@ def test_failed_rank_tears_down_launcher(tmp_path):
     assert time.time() - t0 < 30, "launcher did not tear down promptly"
 
 
+@pytest.mark.slow
+def test_restart_and_resume_after_rank_kill(tmp_path):
+    """The full TPU recovery story (SURVEY.md §5): a host process dies
+    mid-epoch -> the gang-scheduled job fails fast -> a relaunch with
+    ``--resume auto`` continues from the last committed checkpoint with no
+    epoch replay."""
+    common = [
+        "main.py", "--distributed", "--config", "resnet18_cifar10",
+        "--model", "resnet_micro",
+        "--epochs", "2", "--steps-per-epoch", "3", "--batch-size", "16",
+        "--workers", "0", "--log-every", "1",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    # Rank 1 is hard-killed (os._exit) at global step 4 — one step into
+    # epoch 1, after epoch 0's checkpoint (step 3) committed.
+    t0 = time.time()
+    res = _run_launch(2, common + ["--fault-inject", "1:4"], timeout=240)
+    assert res.returncode == 57, res.stdout[-2000:] + res.stderr[-2000:]
+    assert time.time() - t0 < 180, "job did not fail fast after rank death"
+    committed = [d for d in os.listdir(tmp_path / "ck")
+                 if d.startswith("step_")
+                 and os.path.exists(tmp_path / "ck" / d / "COMMIT")]
+    assert committed == ["step_00000003"], committed
+
+    # Relaunch with --resume auto: must continue at epoch 1 (no replay of
+    # epoch 0) and finish the remaining steps.
+    res2 = _run_launch(2, common + ["--resume", "auto"], timeout=240)
+    assert res2.returncode == 0, res2.stdout[-2000:] + res2.stderr[-2000:]
+    assert "resumed from step 3 (epoch 1)" in res2.stdout
+    assert "epoch 0 step" not in res2.stdout  # no epoch replay
+    assert "epoch 1 step 3/3" in res2.stdout
+    steps = [d for d in os.listdir(tmp_path / "ck") if d.startswith("step_")
+             and os.path.exists(tmp_path / "ck" / d / "COMMIT")]
+    assert "step_00000006" in steps  # epoch 1's checkpoint committed
+
+
 def test_launcher_requires_command():
     res = subprocess.run([sys.executable, os.path.join(REPO, "launch.py"),
                           "--nprocs", "2"], capture_output=True, text=True,

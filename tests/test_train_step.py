@@ -15,9 +15,9 @@ from pytorch_distributed_training_example_tpu.parallel import sharding as shardi
 from pytorch_distributed_training_example_tpu.utils.config import Config
 
 
-def _build(mesh, strategy, seed=0, lr=0.1):
+def _build(mesh, strategy, seed=0, lr=0.1, model="resnet_micro"):
     cfg = Config(lr=lr, warmup_epochs=0.0, grad_clip=0.0, weight_decay=1e-4)
-    bundle = registry.create_model("resnet18", num_classes=10, image_size=32,
+    bundle = registry.create_model(model, num_classes=10, image_size=32,
                                    dtype=jnp.float32, param_dtype=jnp.float32)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -35,8 +35,8 @@ def _batch(n=16, seed=0):
             "label": (np.arange(n) % 10).astype(np.int32)}
 
 
-def _run_steps(mesh, strategy, n_steps=3):
-    state, step = _build(mesh, strategy)
+def _run_steps(mesh, strategy, n_steps=3, model="resnet_micro"):
+    state, step = _build(mesh, strategy, model=model)
     with mesh_lib.use_mesh(mesh):
         sh = mesh_lib.batch_sharding(mesh)
         metrics = None
@@ -65,6 +65,19 @@ def test_parallel_matches_single_device(devices, mesh_cfg, strategy):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow
+def test_parallel_matches_single_device_resnet18(devices):
+    """Full-fidelity oracle check on the real reference model (the fast
+    variants above use resnet_micro)."""
+    ref_params, ref_metrics = _run_steps(
+        mesh_lib.single_device_mesh(), "dp", model="resnet18")
+    par_params, par_metrics = _run_steps(
+        mesh_lib.build_mesh({"data": 2, "fsdp": 4}), "fsdp", model="resnet18")
+    assert np.isclose(ref_metrics["loss"], par_metrics["loss"], rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
+
+
 def test_fsdp_actually_shards_params(devices):
     mesh = mesh_lib.build_mesh({"data": 1, "fsdp": 8})
     state, _ = _build(mesh, "fsdp")
@@ -89,15 +102,22 @@ def test_dp_replicates_params(devices):
 
 def test_train_decreases_loss(devices):
     mesh = mesh_lib.build_mesh({"data": 8})
-    state, step = _build(mesh, "dp", lr=0.05)
-    b0 = _batch(n=64, seed=42)
+    state, step = _build(mesh, "dp", lr=0.4)
+    # Separable signal (fixed per-class pattern + noise): the micro oracle
+    # net lacks the capacity to memorize pure noise quickly.
+    r = np.random.RandomState(42)
+    labels = (np.arange(64) % 10).astype(np.int32)
+    patterns = r.randn(10, 32, 32, 3).astype(np.float32)
+    b0 = {"image": 0.3 * r.randn(64, 32, 32, 3).astype(np.float32)
+          + patterns[labels],
+          "label": labels}
     with mesh_lib.use_mesh(mesh):
         sh = mesh_lib.batch_sharding(mesh)
         first = None
-        for _ in range(12):  # same batch -> loss must drop fast
+        for _ in range(25):  # same separable batch -> loss must collapse
             b = prefetch.shard_batch(b0, sh)
             state, m = step(state, b)
             if first is None:
                 first = float(m["loss"])
         last = float(m["loss"])
-    assert last < first * 0.7, (first, last)
+    assert last < first * 0.5, (first, last)

@@ -9,7 +9,7 @@ from pytorch_distributed_training_example_tpu.utils.config import Config
 
 
 def _cfg(tmp_path, **kw):
-    base = dict(model="resnet18", dataset="cifar10", num_classes=10,
+    base = dict(model="resnet_micro", dataset="cifar10", num_classes=10,
                 image_size=32, epochs=2, global_batch_size=32, lr=0.05,
                 warmup_epochs=0.0, precision="fp32", workers=0,
                 steps_per_epoch=3, log_every=3,
