@@ -44,7 +44,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           per_chip_batch: int = 128, steps: int = 20, warmup: int = 10,
           precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
           strategy: str | None = None, mesh_spec: dict | None = None,
-          remat: bool = False, devices=None):
+          remat: bool = False, devices=None, attn_impl: str = "auto"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -68,7 +68,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
     bundle = registry.create_model(model_name, num_classes=cfg.num_classes,
                                    image_size=image_size, seq_len=seq_len,
                                    dtype=policy.compute_dtype,
-                                   param_dtype=policy.param_dtype, remat=remat)
+                                   param_dtype=policy.param_dtype, remat=remat,
+                                   attn_impl=attn_impl)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
     state = train_loop.create_train_state(bundle.module, tx,
@@ -91,19 +92,50 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
         return state, losses
 
     with mesh_lib.use_mesh(mesh):
-        state, losses = run_steps(state, batch)  # compile + warm
+        compiled = run_steps.lower(state, batch).compile()
+        state, losses = compiled(state, batch)  # warm (first run pays setup)
         np.asarray(losses)
         dt = float("inf")
         for _ in range(max(warmup // max(steps, 1), 2)):
             t0 = time.perf_counter()
-            state, losses = run_steps(state, batch)
+            state, losses = compiled(state, batch)
             np.asarray(losses)  # forces execution; per-step losses are real
             dt = min(dt, time.perf_counter() - t0)
+    try:
+        ca = compiled.cost_analysis() or {}
+    except Exception:
+        ca = {}
 
     examples_per_sec = global_batch * steps / dt
     per_chip = examples_per_sec / n_chips
     mfu = metrics_lib.mfu(per_chip, bundle.fwd_flops_per_example)
     unit = f"{bundle.examples_unit}/sec/chip"
+
+    # Roofline placement from XLA's own cost model: is this program compute-
+    # or HBM-bound on this chip, and how close to the bandwidth peak does it
+    # run? (SURVEY.md §6; the ResNet-50/v5e step measures ~95% of peak HBM
+    # BW at arithmetic intensity ~70 flops/byte vs a ~240 ridge point.)
+    roofline = {}
+    step_s = dt / steps
+    if ca.get("bytes accessed") and ca.get("flops"):
+        # XLA's cost model counts a lax.scan body ONCE regardless of trip
+        # count (verified: the 1-step and 10-step lowerings of this program
+        # both report flops 3.06e12, bytes 4.5e10) — so these are already
+        # per-step numbers.
+        bytes_step = ca["bytes accessed"] / n_chips
+        flops_step = ca["flops"] / n_chips
+        peak_bw = metrics_lib.peak_hbm_gbps()
+        intensity = flops_step / bytes_step
+        ridge = metrics_lib.peak_flops_per_chip() / (peak_bw * 1e9)
+        roofline = {
+            "hbm_bytes_per_step": round(bytes_step / 1e9, 3),
+            "achieved_hbm_gbps": round(bytes_step / step_s / 1e9, 1),
+            "peak_hbm_gbps": peak_bw,
+            "xla_flops_per_step": round(flops_step / 1e12, 3),
+            "arithmetic_intensity": round(intensity, 1),
+            "ridge_intensity": round(ridge, 1),
+            "bound": "hbm" if intensity < ridge else "compute",
+        }
     if not quiet:
         print(f"# {n_chips} chip(s) ({jax.devices()[0].device_kind}), "
               f"global batch {global_batch}, {dt/steps*1e3:.1f} ms/step, "
@@ -122,6 +154,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             "step_ms": round(dt / steps * 1e3, 2),
             "precision": precision,
             "strategy": strategy,
+            "attn_impl": attn_impl,
+            **({"roofline": roofline} if roofline else {}),
         },
     }
 
@@ -282,9 +316,13 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--strategy", default=None)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "xla", "flash", "ring", "ulysses"])
     p.add_argument("--include-input", action="store_true",
                    help="also measure loader-only and end-to-end throughput "
                         "over a real JPEG tree (synthetic if no --data-path)")
+    p.add_argument("--no-lm", action="store_true",
+                   help="skip the compute-bound GPT-2 companion row")
     p.add_argument("--data-path", default=None)
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("-v", "--verbose", action="store_true")
@@ -292,7 +330,22 @@ def main(argv=None):
     result = bench(args.model, args.image_size, args.per_chip_batch,
                    args.steps, args.warmup, args.precision,
                    quiet=not args.verbose, seq_len=args.seq_len,
-                   strategy=args.strategy, remat=args.remat)
+                   strategy=args.strategy, remat=args.remat,
+                   attn_impl=args.attn_impl)
+    if args.model == "resnet50" and not args.no_lm:
+        # The ResNet-50 step is HBM-bound on small chips (see roofline
+        # extras); record the compute-bound LM headline alongside it.
+        import jax
+
+        if jax.default_backend() != "cpu":
+            lm = bench("gpt2", per_chip_batch=16, steps=10, warmup=4,
+                       precision=args.precision, seq_len=1024, quiet=True)
+            result["extra"]["lm"] = {
+                "metric": lm["metric"], "value": lm["value"],
+                "unit": lm["unit"], "mfu": lm["extra"]["mfu"],
+                "step_ms": lm["extra"]["step_ms"],
+                "global_batch": lm["extra"]["global_batch"],
+            }
     if args.include_input:
         result["extra"].update(bench_input(
             args.data_path, args.image_size, args.per_chip_batch,

@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-1 absorbs remaining devices)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="gradient checkpointing")
+    p.add_argument("--attn-impl", default=None,
+                   choices=["auto", "xla", "flash", "ring", "ulysses"],
+                   help="attention kernel: Pallas flash, ring (context-"
+                        "parallel), Ulysses all-to-all, or plain XLA")
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--workers", type=int, default=None)

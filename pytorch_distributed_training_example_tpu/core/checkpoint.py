@@ -45,6 +45,7 @@ from pytorch_distributed_training_example_tpu.parallel.sharding import param_pat
 
 COMMIT_FILE = "COMMIT"
 MANIFEST_FILE = "manifest.json"
+SAVING_SUFFIX = ".saving"  # in-progress attempt dirs (never resume-eligible)
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
@@ -102,23 +103,25 @@ class Checkpointer:
             }
 
         step_dir = os.path.join(self.directory, f"step_{step:08d}")
+        attempt_dir = step_dir + SAVING_SUFFIX
         multihost = jax.process_count() > 1
         nproc = jax.process_count()
 
-        # Re-saving a step that a crashed run half-wrote (train to step N,
-        # die mid-save, resume, reach N again): stale files.p*.json sentinels
-        # would satisfy process 0's commit wait while other hosts are still
-        # rewriting arrays -> corrupt COMMITted checkpoint. Clear the stale
-        # dir first, and barrier ON THE MAIN THREAD (same thread that
-        # dispatches train-step collectives, so no cross-thread collective
-        # interleaving) so no host writes before the cleanup.
-        if distributed.is_main_process() and os.path.isdir(step_dir):
-            shutil.rmtree(step_dir, ignore_errors=True)
+        # All hosts write into an ATTEMPT dir that is renamed over the final
+        # dir only when complete — so a committed checkpoint for this step
+        # (e.g. from a run being re-done after --resume to an older step) is
+        # never destroyed before its replacement is fully on disk. A crashed
+        # earlier attempt may have left stale files.p*.json sentinels in the
+        # attempt dir that would satisfy process 0's commit wait early; clear
+        # it behind a MAIN-THREAD barrier (same thread as train-step
+        # collectives, so no cross-thread collective interleaving).
+        if distributed.is_main_process() and os.path.isdir(attempt_dir):
+            shutil.rmtree(attempt_dir, ignore_errors=True)
         if multihost:
             distributed.barrier(f"ckpt_clear_{step}")
 
         def write():
-            arrays_dir = os.path.join(step_dir, "arrays")
+            arrays_dir = os.path.join(attempt_dir, "arrays")
             os.makedirs(arrays_dir, exist_ok=True)
             written: dict[str, list] = {}
             for path, regions in shards.items():
@@ -132,12 +135,13 @@ class Checkpointer:
                 # sentinel: written ATOMICALLY (tmp+rename) after the arrays
                 # so process 0 commits only once every host's data is on the
                 # shared filesystem. No device collective -> async-safe.
-                flist = os.path.join(step_dir, f"files.p{jax.process_index()}.json")
+                flist = os.path.join(attempt_dir,
+                                     f"files.p{jax.process_index()}.json")
                 with open(flist + ".tmp", "w") as fh:
                     json.dump({p: f for p, f in written.items()}, fh)
                 os.replace(flist + ".tmp", flist)
             if distributed.is_main_process():
-                if multihost and not self._await_hosts(step_dir, nproc):
+                if multihost and not self._await_hosts(attempt_dir, nproc):
                     return  # a host died mid-save: leave uncommitted
                 manifest = {
                     "step": step,
@@ -149,13 +153,19 @@ class Checkpointer:
                 }
                 # NOTE: multi-host file listings are per-host in files.p*.json;
                 # restore unions them with the manifest's own list.
-                with open(os.path.join(step_dir, MANIFEST_FILE), "w") as fh:
+                with open(os.path.join(attempt_dir, MANIFEST_FILE), "w") as fh:
                     json.dump(manifest, fh)
+                # Swap attempt -> final. The only unprotected window is the
+                # rmtree+rename pair below (milliseconds, two syscalls) vs.
+                # the whole multi-GB write before this change.
+                if os.path.isdir(step_dir):
+                    shutil.rmtree(step_dir, ignore_errors=True)
+                os.rename(attempt_dir, step_dir)
                 with open(os.path.join(step_dir, COMMIT_FILE), "w") as fh:
                     fh.write(str(step))
                 self._prune()
 
-        # single dir + COMMIT marker is the atomicity boundary
+        # attempt dir + rename + COMMIT marker is the atomicity boundary
         if block:
             write()
         else:
@@ -185,6 +195,14 @@ class Checkpointer:
         for s in steps[: -self.keep] if self.keep else []:
             shutil.rmtree(os.path.join(self.directory, f"step_{s:08d}"),
                           ignore_errors=True)
+        # Orphaned attempts from crashed runs. No live attempt can exist
+        # here: _prune runs at the end of process 0's write thread, and every
+        # host's next save() is gated behind a main-thread barrier that
+        # process 0 only reaches after joining this thread.
+        for name in os.listdir(self.directory):
+            if name.endswith(SAVING_SUFFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     # -- restore ------------------------------------------------------------
 
@@ -264,12 +282,7 @@ def _assemble_sharded(arrays_dir: str, meta: dict, sharding) -> jax.Array:
                                     mmap_mode="r")
         return opened[fname]
 
-    pieces = []
-    for device, idx in index_map.items():
-        bounds = [
-            (s.start or 0, s.stop if s.stop is not None else dim)
-            for s, dim in zip(idx, shape)
-        ]
+    def assemble(bounds):
         block = np.empty([b - a for a, b in bounds],
                          dtype=np.dtype(meta["dtype"]))
         for entry in meta["files"]:
@@ -286,7 +299,25 @@ def _assemble_sharded(arrays_dir: str, meta: dict, sharding) -> jax.Array:
                 block = np.asarray(region(entry["file"])).reshape(())
             else:
                 block[dst_sl] = region(entry["file"])[src_sl]
-        pieces.append(jax.device_put(block, device))
+        return block
+
+    # Group devices by shard region: replicated leaves (DP) assemble each
+    # region ONCE for all devices holding it, and each host block is freed
+    # right after placement so peak host memory stays one shard.
+    by_bounds: dict[tuple, list] = {}
+    for device, idx in index_map.items():
+        bounds = tuple(
+            (s.start or 0, s.stop if s.stop is not None else dim)
+            for s, dim in zip(idx, shape)
+        )
+        by_bounds.setdefault(bounds, []).append(device)
+    placed = {}
+    for bounds, devs in by_bounds.items():
+        block = assemble(bounds)
+        for device in devs:
+            placed[device] = jax.device_put(block, device)
+        del block
+    pieces = [placed[device] for device in index_map]
     return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
 
 

@@ -241,6 +241,10 @@ def attention(
             impl = "ring"
         else:
             impl = "flash" if _flash_eligible(q, k) else "xla"
+    elif impl in ("ring", "ulysses") and ctx == 1:
+        # No context axis to parallelize over (includes init-time tracing
+        # outside use_mesh): both collapse to plain attention.
+        impl = "xla"
     if impl == "ring":
         return ring_attention(q, k, v, mesh=mesh, axis=context_axis,
                               causal=causal, batch_axes=batch_axes)
@@ -248,13 +252,30 @@ def attention(
         return ulysses_attention(q, k, v, mesh=mesh, axis=context_axis,
                                  causal=causal, batch_axes=batch_axes)
     if impl == "flash":
+        if not _flash_eligible(q, k, explicit=True):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "attn_impl='flash' not eligible for shape q=%s k=%s on %s "
+                "(needs seq %% 512 == 0, head_dim in {64,128,256}, TPU); "
+                "falling back to XLA attention",
+                q.shape, k.shape, jax.default_backend())
+            return dot_product_attention(q, k, v, causal=causal)
         from pytorch_distributed_training_example_tpu.ops import flash_attention
 
         return flash_attention.flash_attention(q, k, v, causal=causal)
     return dot_product_attention(q, k, v, causal=causal)
 
 
-def _flash_eligible(q, k) -> bool:
+def _flash_eligible(q, k, explicit: bool = False) -> bool:
+    """Whether the Pallas kernel can (explicit) / should (auto) run.
+
+    ``auto`` additionally requires seq >= 1024 — below that the XLA fusion
+    is already fast and kernel launch overhead dominates; an explicit
+    ``impl='flash'`` only needs the kernel's hard shape constraints.
+    """
     on_tpu = jax.default_backend() not in ("cpu",)
-    seq_ok = q.shape[1] >= 1024 and q.shape[1] % 512 == 0 and k.shape[1] % 512 == 0
+    seq_ok = q.shape[1] % 512 == 0 and k.shape[1] % 512 == 0
+    if not explicit:
+        seq_ok = seq_ok and q.shape[1] >= 1024
     return on_tpu and seq_ok and q.shape[-1] in (64, 128, 256)

@@ -30,6 +30,8 @@ class Config:
     optimizer: str = "sgd"  # sgd | adamw
     label_smoothing: float = 0.0
     grad_clip: float = 0.0
+    # attention kernel: auto | xla | flash (Pallas) | ring (CP) | ulysses
+    attn_impl: str = "auto"
     # precision / memory
     precision: str = "bf16"
     remat: bool = False  # gradient checkpointing (reference configs[4])

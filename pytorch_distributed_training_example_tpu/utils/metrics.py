@@ -67,6 +67,26 @@ PEAK_FLOPS = {
     "cpu": 1e12,            # nominal; CPU MFU is not meaningful
 }
 
+#: HBM bandwidth per chip (GB/s) — the other roofline axis.
+PEAK_HBM_GBPS = {
+    "tpu v4": 1228.0,
+    "tpu v5 lite": 819.0,   # v5e
+    "tpu v5": 2765.0,       # v5p
+    "tpu v5p": 2765.0,
+    "tpu v6 lite": 1640.0,  # trillium
+    "cpu": 100.0,
+}
+
+
+def peak_hbm_gbps(device=None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    for key, val in PEAK_HBM_GBPS.items():
+        if key in kind:
+            return val
+    return PEAK_HBM_GBPS["cpu"]
+
 
 def peak_flops_per_chip(device=None) -> float:
     if device is None:

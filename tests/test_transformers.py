@@ -21,14 +21,15 @@ def _lm_batch(n=8, seed=0, vocab=512):
     return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
 
 
-def _build(model_name, mesh, strategy):
+def _build(model_name, mesh, strategy, seq_len=SEQ):
     # SGD for the equivalence oracle: Adam's per-element normalization turns
     # benign reduction-order noise (~1e-6) on near-zero grads into full-lr
     # sign flips, which is a property of Adam, not of the sharding.
     cfg = Config(lr=1e-2, warmup_epochs=0.0, optimizer="sgd", grad_clip=0.0,
                  weight_decay=0.0)
-    bundle = registry.create_model(model_name, seq_len=SEQ, dtype=jnp.float32,
-                                   param_dtype=jnp.float32)
+    bundle = registry.create_model(model_name, seq_len=seq_len,
+                                   dtype=jnp.float32, param_dtype=jnp.float32,
+                                   sp=strategy.endswith("_sp"))
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
     state = train_loop.create_train_state(bundle.module, tx,
@@ -82,6 +83,68 @@ def test_context_parallel_train_step(devices):
     assert np.isclose(ref_m["loss"], par_m["loss"], rtol=1e-3)
     for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_ulysses_end_to_end_train_step(devices):
+    """Ulysses (all-to-all seq<->head) as the CP implementation of a full
+    train step, selected the way a user would: attn_impl='ulysses'."""
+    mesh = mesh_lib.build_mesh({"data": 2, "context": 4})
+    cfg = Config(lr=1e-2, warmup_epochs=0.0, optimizer="sgd", grad_clip=0.0,
+                 weight_decay=0.0)
+    # llama_tiny: 4 q-heads / 2 kv-heads over 4 context shards (GQA broadcast
+    # path inside ulysses_attention).
+    bundle = registry.create_model("llama_tiny", seq_len=SEQ,
+                                   dtype=jnp.float32, param_dtype=jnp.float32,
+                                   attn_impl="ulysses")
+    tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
+    rules = sharding_lib.strategy_rules("dp", bundle.rules)
+    state = train_loop.create_train_state(bundle.module, tx,
+                                          bundle.input_template, mesh, rules,
+                                          seed=0)
+    step = jax.jit(train_loop.make_train_step(train_loop.get_task("lm")),
+                   donate_argnums=0)
+    with mesh_lib.use_mesh(mesh):
+        sh = mesh_lib.batch_sharding(mesh)
+        for i in range(2):
+            state, m = step(state, prefetch.shard_batch(_lm_batch(seed=i), sh))
+        params = jax.device_get(state.params)
+    # oracle: same run on one device with plain attention
+    ref_params, ref_m = _run("llama_tiny", mesh_lib.single_device_mesh(), "dp")
+    assert np.isclose(ref_m["loss"], float(m["loss"]), rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(params)):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_sp_matches_non_sp(devices):
+    """Megatron SP is a resharding of activations, not a different program:
+    loss/params must match the plain TP run exactly (SURVEY.md §2c SP)."""
+    mesh = mesh_lib.build_mesh({"data": 2, "model": 4})
+    ref_params, ref_m = _run("llama_tiny", mesh, "fsdp_tp")
+    sp_params, sp_m = _run("llama_tiny", mesh, "fsdp_tp_sp")
+    assert np.isclose(ref_m["loss"], sp_m["loss"], rtol=1e-4), (ref_m, sp_m)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(sp_params)):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-4)
+
+
+def test_sp_reduces_activation_memory(devices):
+    """The point of SP: residual-stream activations between matmul regions
+    shard over the TP axis -> per-device temp memory drops."""
+    mesh = mesh_lib.build_mesh({"model": 8})
+    seq = 256
+
+    def temp_bytes(strategy):
+        state, step = _build("llama_tiny", mesh, strategy, seq_len=seq)
+        r = np.random.RandomState(0)
+        toks = r.randint(0, 512, (8, seq + 1)).astype(np.int32)
+        with mesh_lib.use_mesh(mesh):
+            batch = prefetch.shard_batch(
+                {"tokens": toks[:, :-1], "targets": toks[:, 1:]},
+                mesh_lib.batch_sharding(mesh))
+            compiled = step.lower(state, batch).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    plain, sp = temp_bytes("fsdp_tp"), temp_bytes("fsdp_tp_sp")
+    assert sp < plain * 0.9, (sp, plain)
 
 
 def test_remat_matches_no_remat(devices):
