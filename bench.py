@@ -120,10 +120,11 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
     if ca.get("bytes accessed") and ca.get("flops"):
         # XLA's cost model counts a lax.scan body ONCE regardless of trip
         # count (verified: the 1-step and 10-step lowerings of this program
-        # both report flops 3.06e12, bytes 4.5e10) — so these are already
-        # per-step numbers.
-        bytes_step = ca["bytes accessed"] / n_chips
-        flops_step = ca["flops"] / n_chips
+        # both report flops 3.06e12, bytes 4.5e10), and reports PER-DEVICE
+        # (post-GSPMD-partitioning) numbers — so these are already per-step,
+        # per-chip.
+        bytes_step = ca["bytes accessed"]
+        flops_step = ca["flops"]
         peak_bw = metrics_lib.peak_hbm_gbps()
         intensity = flops_step / bytes_step
         ridge = metrics_lib.peak_flops_per_chip() / (peak_bw * 1e9)

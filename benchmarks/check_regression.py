@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Golden-metric regression gate (SURVEY.md §4.5).
+
+Compare a bench.py JSON result (stdin or file) against benchmarks/golden.json
+for the device it ran on; exit 1 if any matched metric regressed more than
+``--tolerance`` (default 10%). Metrics or devices without a golden entry are
+reported but never fail — new hardware/new benchmarks need a first recording.
+
+Usage:
+    python bench.py | python benchmarks/check_regression.py
+    python benchmarks/check_regression.py BENCH_r02.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "golden.json")
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    with open(path) as fh:
+        return {k: v for k, v in json.load(fh).items()
+                if not k.startswith("_")}
+
+
+def iter_rows(result: dict):
+    """A bench result line carries the headline row plus optional extras.lm."""
+    yield result["metric"], float(result["value"]), result.get("extra", {})
+    lm = result.get("extra", {}).get("lm")
+    if lm:
+        yield lm["metric"], float(lm["value"]), result.get("extra", {})
+
+
+def check(result: dict, golden: dict, tolerance: float = 0.10):
+    """Returns (failures, report_lines); a failure is a >tolerance drop."""
+    device = result.get("extra", {}).get("device", "")
+    table = golden.get(device, {})
+    failures, report = [], []
+    for metric, value, _ in iter_rows(result):
+        ref = table.get(metric)
+        if not ref:
+            report.append(f"NO-GOLDEN {metric} ({device}): measured {value}")
+            continue
+        ratio = value / ref["value"]
+        line = (f"{metric} ({device}): {value:.1f} vs golden "
+                f"{ref['value']:.1f} ({ratio:.2%})")
+        if ratio < 1.0 - tolerance:
+            failures.append(line)
+            report.append("REGRESSION " + line)
+        else:
+            report.append("OK " + line)
+    return failures, report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("result", nargs="?", help="bench JSON file (default: stdin)")
+    p.add_argument("--tolerance", type=float, default=0.10)
+    args = p.parse_args(argv)
+    raw = open(args.result).read() if args.result else sys.stdin.read()
+    # Accept either a bare bench line or a driver BENCH_r{N}.json wrapper
+    # (which stores the parsed line under "parsed").
+    data = json.loads(raw.strip().splitlines()[-1])
+    result = data.get("parsed", data)
+    failures, report = check(result, load_golden(), args.tolerance)
+    for line in report:
+        print(line)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -108,6 +108,15 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", platform)
 
+    # Persistent compile cache: repeat invocations (dev loops, restarts,
+    # --resume) skip XLA recompilation. Opt out / relocate via env.
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR", "unset") == "unset":
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/pdtx_compile_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     # Bootstrap BEFORE touching jax.devices(): in multi-host mode every
     # process must rendezvous first (SURVEY.md §3.1 boundary).
     from pytorch_distributed_training_example_tpu.core import distributed

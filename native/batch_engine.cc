@@ -71,6 +71,7 @@ struct Engine {
   const uint8_t* raw_data = nullptr;   // gather mode
   int64_t n = 0, height = 0, width = 0, channels = 0;
   int64_t sample_bytes = 0;            // gather mode row size
+  int64_t stride_bytes = 0;            // gather row stride (overlapping LM windows)
   float mean[8] = {0}, stdinv[8] = {1, 1, 1, 1, 1, 1, 1, 1};
   bool augment = false;
   int pad = 4;
@@ -302,7 +303,7 @@ struct Engine {
     uint8_t* out = static_cast<uint8_t*>(job.out);
     for (size_t i = 0; i < job.indices.size(); ++i) {
       std::memcpy(out + i * sample_bytes,
-                  raw_data + job.indices[i] * sample_bytes,
+                  raw_data + job.indices[i] * stride_bytes,
                   static_cast<size_t>(sample_bytes));
     }
   }
@@ -348,6 +349,10 @@ struct Engine {
 
 extern "C" {
 
+// Bumped on any C-ABI change; the Python bindings refuse mismatches (the
+// library is untracked, so stale binaries can survive checkouts).
+int64_t be_abi_version() { return 2; }
+
 void* be_create_image(const uint8_t* data, int64_t n, int64_t h, int64_t w,
                       int64_t c, const float* mean, const float* std_,
                       int augment, int num_threads) {
@@ -368,12 +373,16 @@ void* be_create_image(const uint8_t* data, int64_t n, int64_t h, int64_t w,
   return e;
 }
 
+// `stride_bytes` is the byte distance between consecutive samples; 0 means
+// densely packed (= sample_bytes). A smaller stride than sample size gives
+// the overlapping windows LM datasets use (sample i = tokens[i*L : i*L+L+1]).
 void* be_create_gather(const uint8_t* data, int64_t n, int64_t sample_bytes,
-                       int num_threads) {
+                       int num_threads, int64_t stride_bytes) {
   Engine* e = new Engine();
   e->raw_data = data;
   e->n = n;
   e->sample_bytes = sample_bytes;
+  e->stride_bytes = stride_bytes > 0 ? stride_bytes : sample_bytes;
   if (num_threads < 1) num_threads = 1;
   for (int i = 0; i < num_threads; ++i)
     e->workers.emplace_back([e] { e->worker_loop(); });

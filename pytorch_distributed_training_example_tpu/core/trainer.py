@@ -64,6 +64,15 @@ class Trainer:
             cfg.dataset, cfg.data_path, train=True, **data_kw)
         self.eval_data = datasets_lib.build_dataset(
             cfg.dataset, cfg.data_path, train=False, **data_kw)
+        if isinstance(self.train_data, datasets_lib.TokenFileDataset):
+            # Out-of-vocab ids don't crash an embedding gather — they clamp
+            # and train to NaN. Fail loudly on a wrong model/data pairing.
+            head = np.asarray(self.train_data.tokens[:1_000_000])
+            if head.size and int(head.max()) >= vocab:
+                raise ValueError(
+                    f"token file {cfg.data_path!r} contains id "
+                    f"{int(head.max())} >= model vocab {vocab} — wrong "
+                    f"--model / --data-path pairing?")
         nproc = jax.process_count()
         if cfg.global_batch_size % max(nproc, 1):
             raise ValueError("global batch size must divide evenly across hosts")
@@ -145,7 +154,8 @@ class Trainer:
             native=cfg.native_loader)
         from pytorch_distributed_training_example_tpu.data import native_loader
 
-        if isinstance(ldr, native_loader.NativeDataLoader):
+        if isinstance(ldr, (native_loader.NativeDataLoader,
+                            native_loader.NativeTokenDataLoader)):
             log.info("using native C++ batch engine for the input pipeline")
         return ldr
 

@@ -37,13 +37,14 @@ def collate(samples: list[dict]) -> dict[str, np.ndarray]:
 
 def build_image_loader(dataset, sampler, batch_size: int, workers: int = 0,
                        native: bool = True):
-    """Pick the fastest available train loader for an image dataset.
+    """Pick the fastest available train loader for a dataset.
 
     One decision point shared by the trainer and the benchmarks: the native
-    C++ engine serves in-memory uint8 arrays (``images_u8``, CIFAR) and
-    all-JPEG directory trees (``jpeg_paths``, ImageNet); everything else —
-    including trees with non-JPEG files, which the native decoder would
-    zero-fill — falls back to the Python :class:`DataLoader`.
+    C++ engine serves in-memory uint8 arrays (``images_u8``, CIFAR),
+    all-JPEG directory trees (``jpeg_paths``, ImageNet), and memmapped token
+    files (``tokens`` + ``seq_len``, LM); everything else — including trees
+    with non-JPEG files, which the native decoder would zero-fill — falls
+    back to the Python :class:`DataLoader`.
     """
     from pytorch_distributed_training_example_tpu.data import native_loader
 
@@ -63,6 +64,10 @@ def build_image_loader(dataset, sampler, batch_size: int, workers: int = 0,
                     augment=augment, num_threads=max(workers, 1))
             except RuntimeError:  # engine built without libjpeg
                 pass
+        if hasattr(dataset, "tokens") and hasattr(dataset, "seq_len"):
+            return native_loader.NativeDataLoader.tokens(
+                dataset.tokens, dataset.seq_len, sampler, batch_size,
+                num_threads=max(workers, 1))
     return DataLoader(dataset, batch_size, sampler, num_workers=workers)
 
 

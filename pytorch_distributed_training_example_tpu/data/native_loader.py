@@ -32,15 +32,25 @@ def _load() -> ctypes.CDLL | None:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                               check=True, capture_output=True, timeout=120)
-            except Exception:
-                return None
+        # ALWAYS invoke make (incremental: a no-op when the .so is newer than
+        # batch_engine.cc). The library is untracked, so a checkout can leave
+        # a stale binary with an old C ABI next to newer sources — loading it
+        # would mis-stride gathers instead of erroring.
+        try:
+            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                           check=True, capture_output=True, timeout=120)
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
+                return None  # no toolchain and no prebuilt library
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
+            return None
+        try:
+            lib.be_abi_version.restype = ctypes.c_int64
+            if lib.be_abi_version() != 2:
+                return None
+        except AttributeError:  # pre-versioning binary
             return None
         lib.be_create_image.restype = ctypes.c_void_p
         lib.be_create_image.argtypes = [
@@ -49,7 +59,8 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
         lib.be_create_gather.restype = ctypes.c_void_p
         lib.be_create_gather.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                         ctypes.c_int64, ctypes.c_int]
+                                         ctypes.c_int64, ctypes.c_int,
+                                         ctypes.c_int64]
         lib.be_create_jpeg.restype = ctypes.c_void_p
         lib.be_create_jpeg.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
@@ -139,10 +150,28 @@ class NativeBatchEngine:
         n = data.shape[0]
         sample_bytes = int(data.nbytes // n)
         handle = lib.be_create_gather(
-            data.ctypes.data_as(ctypes.c_void_p), n, sample_bytes, num_threads)
+            data.ctypes.data_as(ctypes.c_void_p), n, sample_bytes, num_threads,
+            0)
         eng = cls(handle, lib, data.shape[1:], data.dtype,
                   num_threads=num_threads)
         eng._keepalive.append(data)
+        return eng
+
+    @classmethod
+    def gather_windows(cls, flat: np.ndarray, num_samples: int,
+                       window: int, stride: int,
+                       num_threads: int = 2) -> "NativeBatchEngine":
+        """Overlapping-window gather over a flat 1-D array (LM token files):
+        sample i = flat[i*stride : i*stride + window]."""
+        lib = _load()
+        assert lib is not None
+        assert flat.ndim == 1 and flat.flags["C_CONTIGUOUS"]
+        item = flat.dtype.itemsize
+        handle = lib.be_create_gather(
+            flat.ctypes.data_as(ctypes.c_void_p), num_samples, window * item,
+            num_threads, stride * item)
+        eng = cls(handle, lib, (window,), flat.dtype, num_threads=num_threads)
+        eng._keepalive.append(flat)
         return eng
 
     def submit(self, batch_id: int, indices: np.ndarray, out: np.ndarray,
@@ -204,6 +233,19 @@ class NativeDataLoader:
         return cls(None, labels, sampler, batch_size, None, None, augment,
                    num_threads, prefetch, engine=engine)
 
+    @classmethod
+    def tokens(cls, tokens_flat: np.ndarray, seq_len: int, sampler,
+               batch_size: int, num_threads: int = 2,
+               prefetch: int = 4) -> "NativeTokenDataLoader":
+        """Loader over a flat token file via the native window-gather engine."""
+        num_samples = (len(tokens_flat) - 1) // seq_len
+        engine = NativeBatchEngine.gather_windows(
+            np.ascontiguousarray(tokens_flat), num_samples, seq_len + 1,
+            seq_len, num_threads)
+        return NativeTokenDataLoader(
+            None, None, sampler, batch_size, None, None, False,
+            num_threads, prefetch, engine=engine)
+
     def set_epoch(self, epoch: int):
         self.epoch = epoch
         self.sampler.set_epoch(epoch)
@@ -211,11 +253,16 @@ class NativeDataLoader:
     def __len__(self):
         return len(self.sampler) // self.batch_size
 
+    def _emit(self, buf: np.ndarray, bi: np.ndarray) -> dict:
+        """Turn a filled engine buffer + its sample indices into a batch."""
+        return {"image": buf.copy(),
+                "label": self.labels[bi].astype(np.int32)}
+
     def __iter__(self):
         idx = self.sampler.local_indices()
         nb = len(self)
-        h, w, c = self.engine.sample_shape
-        bufs = [np.empty((self.batch_size, h, w, c), self.engine.out_dtype)
+        bufs = [np.empty((self.batch_size, *self.engine.sample_shape),
+                         self.engine.out_dtype)
                 for _ in range(self.prefetch)]
         pending: dict[int, tuple[list[int], np.ndarray]] = {}  # b -> (ids, indices)
 
@@ -249,8 +296,7 @@ class NativeDataLoader:
                 for cid in ids:
                     self.engine.wait(cid)
                 del pending[b]
-                batch = {"image": bufs[b % self.prefetch].copy(),
-                         "label": self.labels[bi].astype(np.int32)}
+                batch = self._emit(bufs[b % self.prefetch], bi)
                 if b + inflight < nb:
                     submit(b + inflight)
                 yield batch
@@ -264,3 +310,19 @@ class NativeDataLoader:
                         self.engine.wait(cid)
                     except TimeoutError:
                         pass
+
+
+class NativeTokenDataLoader(NativeDataLoader):
+    """Token-file loader on the C++ gather engine (overlapping LM windows).
+
+    Produces the same ``{"tokens", "targets"}`` int32 batches as iterating a
+    :class:`~...datasets.TokenFileDataset` through the Python loader — tested
+    bit-for-bit — but the window gather runs on engine threads with the GIL
+    released, straight off the memmapped file. Construct via
+    :meth:`NativeDataLoader.tokens`; all buffering/drain behavior is
+    inherited — only batch emission differs.
+    """
+
+    def _emit(self, buf: np.ndarray, bi: np.ndarray) -> dict:
+        chunk = buf.astype(np.int32)
+        return {"tokens": chunk[:, :-1], "targets": chunk[:, 1:]}

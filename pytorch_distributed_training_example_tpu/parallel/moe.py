@@ -50,13 +50,26 @@ class ExpertFFN(nn.Module):
 
 
 class MoEBlock(nn.Module):
-    """Router + expert FFNs; drop-in replacement for a dense MLP block."""
+    """Router + expert FFNs; drop-in replacement for a dense MLP block.
+
+    Two dispatch implementations, equivalence-tested against each other:
+
+    - ``"gather"`` (default): scatter token ids into an ``[E*C]`` slot table,
+      gather token vectors into ``[E, C, d]``, gather expert outputs back by
+      slot. Memory O(E*C*d + T*k) — scales to real token counts.
+    - ``"einsum"``: the GShard/Switch formulation with an explicit
+      ``[T, E, C]`` dispatch/combine mask. O(T*E*C) memory; kept because its
+      einsums partition very predictably under GSPMD (useful oracle and
+      fallback).
+    """
 
     num_experts: int
     ffn_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+    dispatch_impl: str = "gather"  # "gather" | "einsum"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -89,6 +102,60 @@ class MoEBlock(nn.Module):
         within_cap = pos < capacity
         gate_vals = gate_vals * within_cap
 
+        if self.dispatch_impl == "einsum":
+            out = self._einsum_route(tokens, onehot, pos, within_cap,
+                                     gate_vals, capacity)
+        else:
+            out = self._gather_route(tokens, expert_idx, pos, within_cap,
+                                     gate_vals, capacity)
+
+        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+        me = probs.mean(0)                                # mean router prob
+        ce = onehot[:, 0].mean(0)                         # top-1 routed frac
+        aux = E * jnp.sum(me * ce)
+        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
+        # Router z-loss (ST-MoE): keeps logits from drifting to magnitudes
+        # where fp32 softmax saturates.
+        z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+        self.sow("losses", "moe_z_loss", self.z_loss_weight * z)
+
+        return out.reshape(B, S, d).astype(self.dtype)
+
+    def _experts(self, dispatched):
+        dispatched = mesh_lib.constrain(dispatched, P("expert", None, None))
+        expert_out = ExpertFFN(self.num_experts, self.ffn_dim, self.dtype,
+                               self.param_dtype, name="experts")(dispatched)
+        return mesh_lib.constrain(expert_out, P("expert", None, None))
+
+    def _gather_route(self, tokens, expert_idx, pos, within_cap, gate_vals,
+                      capacity):
+        T, d = tokens.shape
+        E = self.num_experts
+        n_slots = E * capacity
+        # Each kept (token, choice) owns one slot; the trash row (index
+        # n_slots) absorbs dropped tokens. Slots are unique per expert queue
+        # position, so the scatter has no collisions.
+        slot = jnp.where(within_cap,
+                         expert_idx * capacity + pos.astype(jnp.int32),
+                         n_slots)                                   # [T, k]
+        tok_ids = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[:, None], slot.shape)
+        token_for_slot = jnp.full((n_slots + 1,), T, jnp.int32)
+        token_for_slot = token_for_slot.at[slot.reshape(-1)].set(
+            tok_ids.reshape(-1))
+        tokens_pad = jnp.concatenate(
+            [tokens, jnp.zeros((1, d), tokens.dtype)])              # row T = 0
+        dispatched = tokens_pad[token_for_slot[:n_slots]].reshape(
+            E, capacity, d).astype(self.dtype)
+        expert_out = self._experts(dispatched)
+        out_pad = jnp.concatenate(
+            [expert_out.reshape(n_slots, d).astype(jnp.float32),
+             jnp.zeros((1, d), jnp.float32)])                       # trash row
+        y = out_pad[slot]                                           # [T, k, d]
+        return jnp.einsum("tk,tkd->td", gate_vals, y)
+
+    def _einsum_route(self, tokens, onehot, pos, within_cap, gate_vals,
+                      capacity):
         # Dispatch mask [T, k, E, C] -> combined [T, E, C].
         cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                                     dtype=jnp.float32)  # [T,k,C]
@@ -96,25 +163,11 @@ class MoEBlock(nn.Module):
                               cap_onehot * within_cap[..., None])
         combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
                              gate_vals)
-
-        # Route -> experts (expert dim sharded on 'expert'; XLA inserts the
-        # all-to-all), compute, route back.
         dispatched = jnp.einsum("tec,td->ecd", dispatch,
                                 tokens.astype(jnp.float32)).astype(self.dtype)
-        dispatched = mesh_lib.constrain(dispatched, P("expert", None, None))
-        expert_out = ExpertFFN(E, self.ffn_dim, self.dtype, self.param_dtype,
-                               name="experts")(dispatched)
-        expert_out = mesh_lib.constrain(expert_out, P("expert", None, None))
-        out = jnp.einsum("tec,ecd->td", combine,
-                         expert_out.astype(jnp.float32))
-
-        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
-        me = probs.mean(0)                                # mean router prob
-        ce = onehot[:, 0].mean(0)                         # top-1 routed frac
-        aux = E * jnp.sum(me * ce)
-        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
-
-        return out.reshape(B, S, d).astype(self.dtype)
+        expert_out = self._experts(dispatched)
+        return jnp.einsum("tec,ecd->td", combine,
+                          expert_out.astype(jnp.float32))
 
 
 #: Expert-parallel rules: stacked expert weights shard on the 'expert' axis

@@ -14,6 +14,14 @@ fraction (S-1)/(M+S-1) — choose microbatches >= 4x stages. Backward is just
 ``jax.grad`` through the loop: ``ppermute`` transposes to the reverse
 permutation, giving the symmetric backward pipeline automatically.
 
+Inactive fill/drain ticks skip the stage computation via ``lax.cond`` (a
+real XLA conditional, not a discarded ``where``), so the bubble costs idle
+time but no FLOPs. ``remat_stages=True`` recomputes each stage in backward,
+bounding saved activations to the stage *inputs* per microbatch — the
+memory property 1F1B scheduling buys, obtained here compositionally (the
+bubble itself is unchanged; an interleaved 1F1B schedule is the remaining
+upgrade if the bubble ever dominates at large S).
+
 The stage function must be shape-preserving (activation in == activation
 out), which transformer blocks satisfy.
 """
@@ -43,6 +51,7 @@ def pipeline_apply(
     num_microbatches: int,
     axis: str = "stage",
     batch_axes=mesh_lib.BATCH_AXES,
+    remat_stages: bool = False,
 ) -> jax.Array:
     """Run ``stage_fn`` as an S-stage pipeline over microbatches of ``x``.
 
@@ -83,6 +92,8 @@ def pipeline_apply(
         outs = jnp.zeros_like(x_mb)                   # collected on the last stage
 
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        run_stage = (jax.checkpoint(stage_fn, prevent_cse=False)
+                     if remat_stages else stage_fn)
 
         def tick(t, carry):
             buf, outs = carry
@@ -92,8 +103,11 @@ def pipeline_apply(
                             jax.lax.dynamic_index_in_dim(
                                 x_mb, jnp.clip(t, 0, M - 1), keepdims=False),
                             buf)
-            y = stage_fn(params, src)
             active = (mb_idx >= 0) & (mb_idx < M)
+            # Fill/drain ticks skip the stage compute entirely (the ring
+            # still rotates, keeping every device in lockstep).
+            y = jax.lax.cond(active, lambda p, s: run_stage(p, s),
+                             lambda p, s: jnp.zeros_like(s), params, src)
             # Last stage stores its (valid) result.
             is_last = stage == S - 1
             outs = jnp.where(

@@ -90,6 +90,9 @@ class PipelinedLlama:
             x, _ = jax.lax.scan(body, x, p_stage)
             return x
 
+        # remat_stages stays off: with m.remat the per-block checkpoint above
+        # already bounds saved residuals to layer inputs (stage-level remat on
+        # top would only re-recompute the scan).
         x = pp.pipeline_apply(stage_fn, stage_params, x, mesh=self.mesh,
                               num_microbatches=self.num_microbatches)
 

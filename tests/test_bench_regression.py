@@ -1,0 +1,64 @@
+"""Golden-metric regression gate (SURVEY.md §4.5) — pure-python unit tests."""
+
+import json
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import check_regression as cr  # noqa: E402
+
+GOLDEN = {"TPU v5 lite": {
+    "resnet50_imagenet_train_throughput": {"value": 2200.0},
+    "gpt2_lm1024_train_throughput": {"value": 100.0},
+}}
+
+
+def _result(resnet=2250.0, lm=105.0, device="TPU v5 lite"):
+    return {
+        "metric": "resnet50_imagenet_train_throughput", "value": resnet,
+        "extra": {"device": device,
+                  "lm": {"metric": "gpt2_lm1024_train_throughput",
+                         "value": lm, "unit": "s"}},
+    }
+
+
+def test_ok_within_tolerance():
+    failures, report = cr.check(_result(), GOLDEN)
+    assert not failures
+    assert sum(line.startswith("OK") for line in report) == 2
+
+
+def test_headline_regression_fails():
+    failures, _ = cr.check(_result(resnet=1800.0), GOLDEN)
+    assert len(failures) == 1 and "resnet50" in failures[0]
+
+
+def test_lm_row_regression_fails():
+    failures, _ = cr.check(_result(lm=80.0), GOLDEN)
+    assert len(failures) == 1 and "gpt2" in failures[0]
+
+
+def test_unknown_device_never_fails():
+    failures, report = cr.check(_result(resnet=1.0, device="TPU v9"), GOLDEN)
+    assert not failures
+    assert all(line.startswith("NO-GOLDEN") for line in report)
+
+
+def test_cli_handles_driver_wrapper(tmp_path):
+    """The driver's BENCH_r{N}.json wraps the line under 'parsed'."""
+    wrapper = {"rc": 0, "parsed": _result()}
+    f = tmp_path / "bench.json"
+    f.write_text(json.dumps(wrapper))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "check_regression.py"),
+         str(f)], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "resnet50" in proc.stdout
+
+
+def test_real_golden_file_loads():
+    golden = cr.load_golden()
+    assert "TPU v5 lite" in golden

@@ -1,5 +1,7 @@
 """C++ batch engine vs the Python loader (skipped when no toolchain)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -91,6 +93,37 @@ def test_native_dataloader_early_abandon_drains():
     idx = dl.sampler.local_indices()[:4]
     ref = (imgs[idx].astype(np.float32) / 255.0 - 0.5) / 0.25
     np.testing.assert_allclose(first["image"], ref, atol=1e-5)
+
+
+def test_token_loader_matches_python_bitforbit(tmp_path):
+    """Native window-gather over a token file == TokenFileDataset through the
+    Python loader, same sampler order."""
+    from pytorch_distributed_training_example_tpu.data.datasets import (
+        TokenFileDataset)
+    from pytorch_distributed_training_example_tpu.data.loader import (
+        DataLoader, build_image_loader)
+
+    rng = np.random.RandomState(5)
+    toks = rng.randint(0, 50000, 4097).astype(np.uint16)
+    path = tmp_path / "tokens.bin"
+    toks.tofile(path)
+    ds = TokenFileDataset(str(path), seq_len=128)
+    assert len(ds) == 32
+
+    sampler = ShardedSampler(len(ds), shuffle=True, seed=2, drop_last=True)
+    native = build_image_loader(ds, sampler, batch_size=4, workers=2)
+    assert isinstance(native, nl.NativeTokenDataLoader)
+    sampler_py = ShardedSampler(len(ds), shuffle=True, seed=2, drop_last=True)
+    python = DataLoader(ds, 4, sampler_py, num_workers=0)
+
+    native.set_epoch(1)
+    python.set_epoch(1)
+    nb, pb = list(native), list(python)
+    assert len(nb) == len(pb) == 8
+    for a, b in zip(nb, pb):
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+        np.testing.assert_array_equal(a["targets"], b["targets"])
+        assert a["tokens"].dtype == np.int32
 
 
 def test_native_dataloader_rejects_drop_last_false():

@@ -86,10 +86,29 @@ def test_moe_forward_and_aux_loss():
     block, variables, x = _moe_setup()
     out, state = block.apply(variables, x, mutable=["losses"])
     assert out.shape == x.shape
-    (aux,) = jax.tree.leaves(state["losses"])
+    losses = state["losses"]
+    (aux,) = jax.tree.leaves(losses["moe_aux_loss"])
     # raw aux is ~1 for balanced routing (>=1 by Cauchy-Schwarz), times the
     # 0.01 default weight
     assert 0.009 < float(aux) < 0.025
+    (z,) = jax.tree.leaves(losses["moe_z_loss"])
+    assert float(z) >= 0.0  # ST-MoE router z-loss is sown alongside
+
+
+def test_moe_gather_matches_einsum_dispatch():
+    """The O(E*C*d) gather dispatch must equal the O(T*E*C) GShard einsum
+    formulation bit-for-bit in routing decisions (same router weights)."""
+    E, k = 4, 2
+    g = moe_lib.MoEBlock(num_experts=E, ffn_dim=32, top_k=k,
+                         capacity_factor=1.0, dispatch_impl="gather")
+    e = moe_lib.MoEBlock(num_experts=E, ffn_dim=32, top_k=k,
+                         capacity_factor=1.0, dispatch_impl="einsum")
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 16, D), jnp.float32)
+    variables = g.init(jax.random.PRNGKey(0), x)
+    out_g = g.apply(variables, x)
+    out_e = e.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_moe_expert_parallel_matches_replicated(devices):
