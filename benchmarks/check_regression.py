@@ -63,9 +63,12 @@ def main(argv=None):
     p.add_argument("--tolerance", type=float, default=0.10)
     args = p.parse_args(argv)
     raw = open(args.result).read() if args.result else sys.stdin.read()
-    # Accept either a bare bench line or a driver BENCH_r{N}.json wrapper
-    # (which stores the parsed line under "parsed").
-    data = json.loads(raw.strip().splitlines()[-1])
+    # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result under
+    # "parsed") or piped bench.py output (last stdout line is the JSON).
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError:
+        data = json.loads(raw.strip().splitlines()[-1])
     result = data.get("parsed", data)
     failures, report = check(result, load_golden(), args.tolerance)
     for line in report:

@@ -154,8 +154,7 @@ class Trainer:
             native=cfg.native_loader)
         from pytorch_distributed_training_example_tpu.data import native_loader
 
-        if isinstance(ldr, (native_loader.NativeDataLoader,
-                            native_loader.NativeTokenDataLoader)):
+        if isinstance(ldr, native_loader.NativeDataLoader):
             log.info("using native C++ batch engine for the input pipeline")
         return ldr
 

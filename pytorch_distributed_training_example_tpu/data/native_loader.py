@@ -35,10 +35,16 @@ def _load() -> ctypes.CDLL | None:
         # ALWAYS invoke make (incremental: a no-op when the .so is newer than
         # batch_engine.cc). The library is untracked, so a checkout can leave
         # a stale binary with an old C ABI next to newer sources — loading it
-        # would mis-stride gathers instead of erroring.
+        # would mis-stride gathers instead of erroring. An flock serializes
+        # concurrent ranks (launch.py spawns N processes that would otherwise
+        # race the compiler on the same output file).
         try:
-            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                           check=True, capture_output=True, timeout=120)
+            import fcntl
+
+            with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                               check=True, capture_output=True, timeout=120)
         except Exception:
             if not os.path.exists(_LIB_PATH):
                 return None  # no toolchain and no prebuilt library

@@ -48,10 +48,11 @@ def test_unknown_device_never_fails():
 
 
 def test_cli_handles_driver_wrapper(tmp_path):
-    """The driver's BENCH_r{N}.json wraps the line under 'parsed'."""
+    """The driver's BENCH_r{N}.json wraps the line under 'parsed' and is
+    pretty-printed (multi-line)."""
     wrapper = {"rc": 0, "parsed": _result()}
     f = tmp_path / "bench.json"
-    f.write_text(json.dumps(wrapper))
+    f.write_text(json.dumps(wrapper, indent=2))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "check_regression.py"),
          str(f)], capture_output=True, text=True)
