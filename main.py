@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-1 absorbs remaining devices)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="gradient checkpointing")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   dest="grad_accum_steps",
+                   help="gradient-accumulation microbatches per step")
     p.add_argument("--attn-impl", default=None,
                    choices=["auto", "xla", "flash", "ring", "ulysses"],
                    help="attention kernel: Pallas flash, ring (context-"

@@ -48,9 +48,14 @@ class ClassificationTask:
         return metrics_lib.cross_entropy(logits, batch["label"], self.label_smoothing)
 
     def metrics(self, logits, batch):
+        """LINEAR per-batch metrics only (averaged across grad-accum
+        microbatches); nonlinear ones go in :meth:`metrics_from_loss`."""
         counts = metrics_lib.topk_correct(logits, batch["label"])
         n = jnp.asarray(batch["label"].shape[0], jnp.float32)
         return {f"acc_{k}": v / n for k, v in counts.items()}
+
+    def metrics_from_loss(self, loss):
+        return {}
 
     def eval_stats(self, logits, batch):
         """Exact global sums (mask-aware for padded final eval batches)."""
@@ -75,7 +80,11 @@ class LanguageModelingTask:
         return metrics_lib.cross_entropy(logits, batch["targets"])
 
     def metrics(self, logits, batch):
-        loss = self.loss(logits, batch)
+        return {}
+
+    def metrics_from_loss(self, loss):
+        # Derived AFTER loss averaging: mean(exp(l_i)) over microbatches
+        # would be Jensen-biased upward vs exp(mean(l_i)).
         return {"perplexity": jnp.exp(loss)}
 
     def eval_stats(self, logits, batch):
@@ -148,26 +157,29 @@ def create_train_state(
 # ---------------------------------------------------------------------------
 
 
-def make_train_step(task) -> Callable:
+def make_train_step(task, grad_accum: int = 1) -> Callable:
     """Build the pure ``(state, batch) -> (state, metrics)`` function.
 
     Callers wrap it in ``jax.jit(..., donate_argnums=0)`` under the mesh:
     sharding propagates from the state/batch, so one builder serves every
     strategy. Precision is carried by the model's dtypes and, for fp16, by
     ``state.scaler`` (presence enables GradScaler semantics at trace time).
+
+    ``grad_accum > 1`` splits the batch into that many microbatches inside
+    the compiled step (``lax.scan``), averaging gradients before ONE
+    optimizer update — same numbers as the large batch (equivalence-tested)
+    at 1/G the activation memory. BatchNorm running stats chain through the
+    microbatches sequentially.
     """
 
-    def train_step(state: TrainState, batch: dict):
-        step_rng = (jax.random.fold_in(state.rng, state.step)
-                    if state.rng is not None else jax.random.PRNGKey(0))
-
+    def compute_grads(state: TrainState, batch: dict, step_rng, batch_stats):
         def loss_fn(params):
             variables = {"params": params}
             # "losses" collects model-internal auxiliary terms (MoE load
             # balancing); "batch_stats" is BatchNorm's running stats.
             mutable = ["losses"]
-            if state.batch_stats is not None:
-                variables["batch_stats"] = state.batch_stats
+            if batch_stats is not None:
+                variables["batch_stats"] = batch_stats
                 mutable.append("batch_stats")
             inputs = [batch[k] for k in task.inputs]
             logits, new_vars = state.apply_fn(
@@ -179,8 +191,57 @@ def make_train_step(task) -> Callable:
             scaled = state.scaler.scale_loss(loss) if state.scaler is not None else loss
             return scaled, (loss, logits, new_vars.get("batch_stats"))
 
-        grads, (loss, logits, new_batch_stats) = jax.grad(
-            loss_fn, has_aux=True)(state.params)
+        return jax.grad(loss_fn, has_aux=True)(state.params)
+
+    def train_step(state: TrainState, batch: dict):
+        step_rng = (jax.random.fold_in(state.rng, state.step)
+                    if state.rng is not None else jax.random.PRNGKey(0))
+
+        if grad_accum <= 1:
+            grads, (loss, logits, new_batch_stats) = compute_grads(
+                state, batch, step_rng, state.batch_stats)
+            task_metrics = task.metrics(logits, batch)
+        else:
+            G = grad_accum
+            micro = jax.tree.map(
+                lambda x: mesh_lib.constrain(
+                    x.reshape(G, x.shape[0] // G, *x.shape[1:]),
+                    P(None, mesh_lib.BATCH_AXES)), batch)
+
+            def body(carry, xs):
+                g_acc, l_acc, m_acc, bs, i = carry
+                mb, = xs
+                g, (l, logits, new_bs) = compute_grads(
+                    state, mb, jax.random.fold_in(step_rng, i), bs)
+                g_acc = jax.tree.map(jnp.add, g_acc, g)
+                m_acc = jax.tree.map(jnp.add, m_acc, task.metrics(logits, mb))
+                bs = new_bs if new_bs is not None else bs
+                return (g_acc, l_acc + l, m_acc, bs, i + 1), None
+
+            # Zero-seeded carry (shapes via eval_shape, so the traced program
+            # contains ONE copy of forward+backward, not an unrolled first
+            # microbatch plus the scan body).
+            mb0 = jax.tree.map(lambda x: x[0], micro)
+            m_shape = jax.eval_shape(
+                lambda: task.metrics(
+                    state.apply_fn(
+                        {"params": state.params, **(
+                            {"batch_stats": state.batch_stats}
+                            if state.batch_stats is not None else {})},
+                        *[mb0[k] for k in task.inputs], train=False), mb0))
+            carry0 = (
+                jax.tree.map(jnp.zeros_like, state.params),
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), m_shape),
+                state.batch_stats,
+                jnp.int32(0),
+            )
+            (grads, loss, task_metrics, new_batch_stats, _), _ = jax.lax.scan(
+                body, carry0, (micro,))
+            inv = 1.0 / G
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss = loss * inv
+            task_metrics = jax.tree.map(lambda m: m * inv, task_metrics)
 
         bn_update = ({"batch_stats": new_batch_stats}
                      if new_batch_stats is not None else {})
@@ -200,7 +261,8 @@ def make_train_step(task) -> Callable:
         else:
             new_state = state.apply_gradients(grads, **bn_update)
 
-        metrics = {"loss": loss, **task.metrics(logits, batch),
+        metrics = {"loss": loss, **task_metrics,
+                   **task.metrics_from_loss(loss),
                    "grad_norm": global_norm(grads)}
         if state.scaler is not None:
             metrics["loss_scale"] = new_scaler.scale

@@ -82,6 +82,12 @@ class Trainer:
                 f"--batch-size {cfg.global_batch_size} must be divisible by the "
                 f"data-parallel degree {dp} (mesh data x fsdp); e.g. use "
                 f"{(cfg.global_batch_size // dp + 1) * dp}")
+        if cfg.grad_accum_steps > 1 and cfg.global_batch_size % (
+                dp * cfg.grad_accum_steps):
+            raise ValueError(
+                f"--batch-size {cfg.global_batch_size} must be divisible by "
+                f"data-parallel degree ({dp}) x --grad-accum "
+                f"({cfg.grad_accum_steps})")
         self.local_batch = cfg.global_batch_size // nproc
         train_sampler = sampler_lib.ShardedSampler(
             len(self.train_data), nproc, jax.process_index(), shuffle=True,
@@ -118,8 +124,9 @@ class Trainer:
             self.mesh, rules, seed=cfg.seed, scaler=scaler)
 
         task = train_loop.get_task(self.bundle.task, cfg.label_smoothing)
-        self.train_step = jax.jit(train_loop.make_train_step(task),
-                                  donate_argnums=0)
+        self.train_step = jax.jit(
+            train_loop.make_train_step(task, cfg.grad_accum_steps),
+            donate_argnums=0)
         self.eval_step = jax.jit(train_loop.make_eval_step(task))
         self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
 

@@ -35,6 +35,7 @@ class Config:
     # precision / memory
     precision: str = "bf16"
     remat: bool = False  # gradient checkpointing (reference configs[4])
+    grad_accum_steps: int = 1  # microbatches per optimizer step (in-step scan)
     pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)
     strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)
