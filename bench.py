@@ -318,7 +318,8 @@ def main(argv=None):
     p.add_argument("--strategy", default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "xla", "flash", "ring", "ulysses"])
+                   choices=["auto", "xla", "flash", "ring", "ring_zigzag",
+                            "ulysses"])
     p.add_argument("--include-input", action="store_true",
                    help="also measure loader-only and end-to-end throughput "
                         "over a real JPEG tree (synthetic if no --data-path)")

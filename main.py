@@ -48,7 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="grad_accum_steps",
                    help="gradient-accumulation microbatches per step")
     p.add_argument("--attn-impl", default=None,
-                   choices=["auto", "xla", "flash", "ring", "ulysses"],
+                   choices=["auto", "xla", "flash", "ring", "ring_zigzag",
+                            "ulysses"],
                    help="attention kernel: Pallas flash, ring (context-"
                         "parallel), Ulysses all-to-all, or plain XLA")
     p.add_argument("--seq-len", type=int, default=None)

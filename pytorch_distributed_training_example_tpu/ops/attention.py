@@ -147,23 +147,159 @@ def ring_attention(
         acc = jnp.zeros((B, H, s_local, D), jnp.float32)
         q_offset = idx * s_local
 
-        def body(step, carry):
-            m, l, acc, kb, vb = carry
+        def compute(step, m, l, acc, kb, vb):
             # K/V block currently held came from shard (idx - step) mod c.
             src = (idx - step) % c
-            k_offset = src * s_local
-            m, l, acc = _online_block(q, kb, vb, causal=causal,
-                                      q_offset=q_offset, k_offset=k_offset,
-                                      m=m, l=l, acc=acc)
+            return _online_block(q, kb, vb, causal=causal,
+                                 q_offset=q_offset, k_offset=src * s_local,
+                                 m=m, l=l, acc=acc)
+
+        def body(step, carry):
+            m, l, acc, kb, vb = carry
+            m, l, acc = compute(step, m, l, acc, kb, vb)
             # Rotate: send our block to the next shard, receive previous.
             perm = [(j, (j + 1) % c) for j in range(c)]
             kb = jax.lax.ppermute(kb, axis, perm)
             vb = jax.lax.ppermute(vb, axis, perm)
             return m, l, acc, kb, vb
 
-        m, l, acc, _, _ = jax.lax.fori_loop(0, c, body, (m, l, acc, k, v))
+        # Final step outside the loop: its rotation would be discarded, and
+        # 1/c of the schedule's ICI traffic with it.
+        m, l, acc, kb, vb = jax.lax.fori_loop(0, c - 1, body, (m, l, acc, k, v))
+        m, l, acc = compute(c - 1, m, l, acc, kb, vb)
         out = acc / jnp.maximum(l, 1e-30)[..., None]   # [B,H,Q,D]
         return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+    spec = P(batch_axes, axis, h_ax, None)
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def zigzag_ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "context",
+    causal: bool = True,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "model",
+) -> jax.Array:
+    """Load-balanced causal ring attention (zigzag chunk placement).
+
+    A contiguous ring under a causal mask is imbalanced: shard 0's queries
+    only ever attend to 1/c of the KV while shard c-1 attends to all of it,
+    and because the ring rotates in lockstep every tick runs at the slowest
+    shard's pace. Zigzag placement splits the sequence into ``2c`` chunks
+    and gives shard ``i`` the pair ``(i, 2c-1-i)`` — one early + one late
+    chunk — so every shard does ~the same causal work on every tick
+    (the Llama-3 context-parallel schedule).
+
+    Chunks are re-laid out with two static ``ppermute``s (one per local
+    half), rung for ``c`` steps over the paired KV halves with 4 sub-block
+    online-softmax updates per tick (fully-masked sub-blocks are skipped
+    with ``lax.cond``), then outputs are permuted back to the contiguous
+    layout. Exactly equals full attention (oracle-tested, incl. grads).
+    """
+    c = mesh.shape[axis]
+    if c == 1:
+        return dot_product_attention(q, k, v, causal=causal)
+    if not causal or q.shape[1] % (2 * c) != 0:
+        # Balance only matters under a causal mask; odd half-chunks fall
+        # back to the contiguous schedule.
+        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal,
+                              batch_axes=batch_axes, head_axis=head_axis)
+    tp = mesh.shape.get(head_axis, 1)
+    h_ax = head_axis if (tp > 1 and q.shape[2] % tp == 0
+                         and k.shape[2] % tp == 0) else None
+
+    # Static chunk routing. Contiguous shard i holds chunks (2i, 2i+1);
+    # zigzag shard j holds {j, 2c-1-j}: slot A gets chunk j for even j else
+    # 2c-1-j, slot B the other one (parity falls out of the permutation).
+    def dest_first(i):
+        return 2 * i if 2 * i < c else 2 * c - 1 - 2 * i
+
+    def dest_second(i):
+        return 2 * i + 1 if 2 * i + 1 < c else 2 * c - 2 - 2 * i
+
+    perm_a = [(i, dest_first(i)) for i in range(c)]
+    perm_b = [(i, dest_second(i)) for i in range(c)]
+    inv_a = [(d, s) for s, d in perm_a]
+    inv_b = [(d, s) for s, d in perm_b]
+
+    def local_fn(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        L = q.shape[1]
+        h = L // 2
+        B, _, H, D = q.shape
+
+        def scatter(x):
+            xa = jax.lax.ppermute(x[:, :h], axis, perm_a)
+            xb = jax.lax.ppermute(x[:, h:], axis, perm_b)
+            return xa, xb
+
+        (qa, qb), (ka, kb), (va, vb) = scatter(q), scatter(k), scatter(v)
+
+        def chunk_ids(j):
+            a = jnp.where(j % 2 == 0, j, 2 * c - 1 - j)
+            return a, (2 * c - 1 - j) - a + j  # the partner chunk
+
+        my_a, my_b = chunk_ids(idx)
+        Hq = q.shape[2]
+        state = [
+            (jnp.full((B, Hq, h), NEG_INF, jnp.float32),
+             jnp.zeros((B, Hq, h), jnp.float32),
+             jnp.zeros((B, Hq, h, D), jnp.float32))
+            for _ in range(2)
+        ]
+
+        def compute(step, sa, sb, ka, kb, va, vb):
+            src = (idx - step) % c
+            src_a, src_b = chunk_ids(src)
+
+            def update(s, q_half, q_chunk, k_half, v_half, k_chunk):
+                m, l, acc = s
+                active = k_chunk <= q_chunk  # causal: skip all-future chunks
+
+                def do(ops):
+                    m, l, acc, kh, vh = ops
+                    return _online_block(
+                        q_half, kh, vh, causal=True,
+                        q_offset=q_chunk * h, k_offset=k_chunk * h,
+                        m=m, l=l, acc=acc)
+
+                return jax.lax.cond(active, do,
+                                    lambda ops: (ops[0], ops[1], ops[2]),
+                                    (m, l, acc, k_half, v_half))
+
+            for k_half, v_half, k_chunk in ((ka, va, src_a), (kb, vb, src_b)):
+                sa = update(sa, qa, my_a, k_half, v_half, k_chunk)
+                sb = update(sb, qb, my_b, k_half, v_half, k_chunk)
+            return sa, sb
+
+        def body(step, carry):
+            sa, sb, ka, kb, va, vb = carry
+            sa, sb = compute(step, sa, sb, ka, kb, va, vb)
+            ring = [(j, (j + 1) % c) for j in range(c)]
+            ka = jax.lax.ppermute(ka, axis, ring)
+            kb = jax.lax.ppermute(kb, axis, ring)
+            va = jax.lax.ppermute(va, axis, ring)
+            vb = jax.lax.ppermute(vb, axis, ring)
+            return sa, sb, ka, kb, va, vb
+
+        # Last step hoisted out of the loop (its rotation would be waste).
+        sa, sb, ka, kb, va, vb = jax.lax.fori_loop(
+            0, c - 1, body, (state[0], state[1], ka, kb, va, vb))
+        sa, sb = compute(c - 1, sa, sb, ka, kb, va, vb)
+
+        def finish(s):
+            m, l, acc = s
+            out = acc / jnp.maximum(l, 1e-30)[..., None]
+            return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+        # Send each output half back to its contiguous home.
+        oa = jax.lax.ppermute(finish(sa), axis, inv_a)
+        ob = jax.lax.ppermute(finish(sb), axis, inv_b)
+        return jnp.concatenate([oa, ob], axis=1)
 
     spec = P(batch_axes, axis, h_ax, None)
     return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
@@ -228,9 +364,11 @@ def attention(
 ):
     """Dispatcher used by the models.
 
-    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'. 'auto' picks ring
-    when the ambient mesh has a context axis > 1, the Pallas flash kernel on
-    TPU for long sequences, else plain XLA.
+    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ring_zigzag' | 'ulysses'.
+    'auto' picks ring when the ambient mesh has a context axis > 1, the
+    Pallas flash kernel on TPU for long sequences, else plain XLA. Causal
+    rings use the load-balanced zigzag schedule when the sequence divides
+    into 2*ctx chunks (see :func:`zigzag_ring_attention`).
     """
     from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 
@@ -238,14 +376,20 @@ def attention(
     ctx = mesh.shape.get(context_axis, 1) if mesh is not None else 1
     if impl == "auto":
         if ctx > 1:
-            impl = "ring"
+            impl = "ring_zigzag" if causal else "ring"
         else:
             impl = "flash" if _flash_eligible(q, k) else "xla"
-    elif impl in ("ring", "ulysses") and ctx == 1:
+    elif impl in ("ring", "ring_zigzag", "ulysses") and ctx == 1:
         # No context axis to parallelize over (includes init-time tracing
-        # outside use_mesh): both collapse to plain attention.
+        # outside use_mesh): all collapse to plain attention.
         impl = "xla"
+    if impl == "ring_zigzag":
+        # Self-falls-back to contiguous when non-causal or indivisible.
+        return zigzag_ring_attention(q, k, v, mesh=mesh, axis=context_axis,
+                                     causal=causal, batch_axes=batch_axes)
     if impl == "ring":
+        # Explicit 'ring' = the contiguous schedule (so the two can be
+        # benchmarked against each other); only 'auto' upgrades causal runs.
         return ring_attention(q, k, v, mesh=mesh, axis=context_axis,
                               causal=causal, batch_axes=batch_axes)
     if impl == "ulysses":

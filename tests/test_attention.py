@@ -43,6 +43,43 @@ def test_ring_gqa_and_grads(devices):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("ctx", [2, 4, 8])
+def test_zigzag_ring_matches_oracle(devices, ctx):
+    mesh = mesh_lib.build_mesh({"context": ctx, "data": 8 // ctx})
+    q, k, v = _qkv(B=8)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    out = A.zigzag_ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_ring_gqa_tp_and_grads(devices):
+    mesh = mesh_lib.build_mesh({"context": 4, "model": 2})
+    q, k, v = _qkv(H=4, Hkv=2)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    out = A.zigzag_ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(
+        lambda *a: A.zigzag_ring_attention(*a, mesh=mesh, causal=True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zigzag_falls_back_when_indivisible(devices):
+    """Sequence not divisible into 2c chunks -> contiguous ring, same result."""
+    mesh = mesh_lib.build_mesh({"context": 8})
+    q, k, v = _qkv(S=24)  # 24 % 16 != 0
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    out = A.zigzag_ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_oracle(devices, causal):
     mesh = mesh_lib.build_mesh({"context": 4, "data": 2})

@@ -171,6 +171,36 @@ def test_remat_matches_no_remat(devices):
     assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
 
 
+def test_vit_tp_matches_single_device(devices):
+    """ViT family TP rules: fsdp_tp equals the single-device oracle."""
+    def run(mesh, strategy):
+        cfg = Config(lr=1e-2, warmup_epochs=0.0, optimizer="sgd",
+                     grad_clip=0.0, weight_decay=0.0)
+        bundle = registry.create_model("vit_tiny", num_classes=10,
+                                       image_size=32, dtype=jnp.float32,
+                                       param_dtype=jnp.float32)
+        tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
+        rules = sharding_lib.strategy_rules(strategy, bundle.rules)
+        state = train_loop.create_train_state(
+            bundle.module, tx, bundle.input_template, mesh, rules, seed=0)
+        step = jax.jit(train_loop.make_train_step(
+            train_loop.get_task(bundle.task)), donate_argnums=0)
+        r = np.random.RandomState(0)
+        b = {"image": r.randn(16, 32, 32, 3).astype(np.float32),
+             "label": (np.arange(16) % 10).astype(np.int32)}
+        with mesh_lib.use_mesh(mesh):
+            state, m = step(state, prefetch.shard_batch(
+                b, mesh_lib.batch_sharding(mesh)))
+            return jax.device_get(state.params), float(m["loss"])
+
+    ref_params, ref_loss = run(mesh_lib.single_device_mesh(), "dp")
+    par_params, par_loss = run(mesh_lib.build_mesh({"data": 2, "model": 4}),
+                               "fsdp_tp")
+    assert np.isclose(ref_loss, par_loss, rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
 def test_vit_train_step(devices):
     mesh = mesh_lib.build_mesh({"data": 8})
     cfg = Config(lr=1e-3, optimizer="adamw")
