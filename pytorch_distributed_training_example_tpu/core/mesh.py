@@ -79,6 +79,27 @@ class MeshConfig:
         return tuple(sizes)
 
 
+def dcn_split(shape: Sequence[int], num_slices: int) -> tuple[tuple, tuple]:
+    """Split a logical mesh shape into (per-slice ICI shape, DCN shape).
+
+    Multislice rule (SURVEY.md §2d): the slice dimension — the only traffic
+    that crosses DCN — must land on the OUTERMOST data-parallel axis whose
+    size it divides (``data`` first, then ``fsdp``), so gradient psum is
+    what rides DCN while TP/CP/EP collectives stay on intra-slice ICI.
+    """
+    dcn = [1] * len(shape)
+    for i in (0, 1):  # data, fsdp
+        if shape[i] % num_slices == 0:
+            dcn[i] = num_slices
+            break
+    else:
+        raise ValueError(
+            f"multislice with {num_slices} slices needs a data or fsdp axis "
+            f"divisible by it; mesh is {dict(zip(AXES, shape))}")
+    ici = tuple(s // d for s, d in zip(shape, dcn))
+    return ici, tuple(dcn)
+
+
 def build_mesh(
     config: MeshConfig | dict | None = None,
     *,
@@ -88,7 +109,10 @@ def build_mesh(
 
     Uses ``mesh_utils.create_device_mesh`` so the logical mesh is laid out
     along the physical ICI torus (nearest-neighbor axes get the fastest
-    links); falls back to a plain reshape for CPU/fake devices.
+    links); multislice device sets (distinct ``slice_index``) go through
+    ``create_hybrid_device_mesh`` with the slice dimension on the outermost
+    data axis (DCN-major). Falls back to a plain reshape for CPU/fake
+    devices.
     """
     if config is None:
         config = MeshConfig()
@@ -98,12 +122,20 @@ def build_mesh(
         devices = jax.devices()
     devices = list(devices)
     shape = config.resolve(len(devices))
-    try:
+    slices = {getattr(d, "slice_index", 0) for d in devices}
+    if len(slices) > 1:
+        ici, dcn = dcn_split(shape, len(slices))  # config errors surface
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except Exception:
-        dev_array = np.asarray(devices).reshape(shape)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices)
+    else:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXES)
 
 

@@ -46,3 +46,25 @@ def test_constrain_prunes_missing_axes(devices):
 def test_single_device_mesh(devices):
     m = mesh_lib.single_device_mesh()
     assert mesh_lib.dp_size(m) == 1
+
+
+def test_dcn_split_prefers_data_axis():
+    # 2 slices over data=4: slice dim on data; everything else ICI-local.
+    ici, dcn = mesh_lib.dcn_split((4, 2, 1, 1, 2, 2), 2)
+    assert dcn == (2, 1, 1, 1, 1, 1)
+    assert ici == (2, 2, 1, 1, 2, 2)
+
+
+def test_dcn_split_falls_back_to_fsdp():
+    # data=1 (pure-FSDP config): the slice dim lands on fsdp.
+    ici, dcn = mesh_lib.dcn_split((1, 8, 1, 1, 1, 2), 4)
+    assert dcn == (1, 4, 1, 1, 1, 1)
+    assert ici == (1, 2, 1, 1, 1, 2)
+
+
+def test_dcn_split_rejects_model_axis_crossing_dcn():
+    # TP over DCN is never what you want; indivisible data/fsdp must raise.
+    import pytest
+
+    with pytest.raises(ValueError, match="data or fsdp"):
+        mesh_lib.dcn_split((3, 1, 1, 1, 1, 8), 2)
