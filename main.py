@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", default=None, nargs="?", const="auto",
                    help="checkpoint dir or 'auto' (newest committed)")
+    p.add_argument("--evaluate", action="store_true",
+                   help="evaluation only (use with --resume to score a "
+                        "checkpoint); no training")
     p.add_argument("--profile-steps", default=None,
                    help="'start:stop' global-step range to trace")
     p.add_argument("--fault-inject", default=None,
@@ -134,6 +137,17 @@ def main(argv=None):
     from pytorch_distributed_training_example_tpu.core.trainer import Trainer
 
     trainer = Trainer(cfg)
+    if args.evaluate:
+        # Reference-CLI parity: the canonical ImageNet example's --evaluate
+        # runs validation on the (resumed) model and exits. Scoring a fresh
+        # init is never what the user meant — fail loudly.
+        if not trainer.resumed:
+            raise SystemExit(
+                "--evaluate needs restored weights: pass --resume with a "
+                "committed checkpoint (nothing was loaded)")
+        trainer.evaluate(max(trainer.start_epoch - 1, 0))
+        trainer.metric_logger.close()
+        return 0
     trainer.train()
     return 0
 

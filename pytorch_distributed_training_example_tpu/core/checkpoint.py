@@ -206,9 +206,16 @@ class Checkpointer:
 
     # -- restore ------------------------------------------------------------
 
-    def restore(self, state_template, step: int | None = None):
+    def restore(self, state_template, step: int | None = None,
+                allow_partial: bool = False):
         """Restore into the shardings of ``state_template`` (a real or abstract
-        TrainState whose leaves carry ``.sharding``). Returns (state, extra)."""
+        TrainState whose leaves carry ``.sharding``). Returns (state, extra).
+
+        By default every model parameter must be present in the checkpoint
+        with a matching shape — resuming is all-or-nothing, because training
+        or evaluating a half-initialized model is silent garbage.
+        ``allow_partial=True`` downgrades mismatches to a warning (surgical
+        transfer-learning loads)."""
         if step is None:
             step = latest_checkpoint(self.directory)
             if step is None:
@@ -230,15 +237,39 @@ class Checkpointer:
         flat_template = _flatten(state_template)
 
         restored: dict[str, Any] = {}
+        shape_mismatch: list[str] = []
         for path, meta in leaves.items():
-            if path not in flat_template:
+            target = flat_template.get(path)
+            if target is None:
                 continue
-            target = flat_template[path]
+            if tuple(meta["shape"]) != tuple(np.shape(target)):
+                # Same layer name, different architecture (e.g. resnet18
+                # checkpoint into resnet_micro): loading it would blow up
+                # later inside flax with a much less useful error.
+                shape_mismatch.append(path)
+                continue
             if hasattr(target, "sharding"):
                 restored[path] = _assemble_sharded(
                     arrays_dir, meta, target.sharding)
             else:
                 restored[path] = _assemble_full(arrays_dir, meta)
+
+        want_params = [p for p in flat_template if p.startswith("params")]
+        missing = [p for p in want_params if p not in restored]
+        if missing:
+            detail = (f"{len(missing)}/{len(want_params)} model parameters "
+                      f"missing or shape-mismatched (e.g. {missing[:3]}; "
+                      f"{len(shape_mismatch)} shape mismatches)")
+            if not allow_partial:
+                raise ValueError(
+                    f"checkpoint at {step_dir!r} does not match this model: "
+                    f"{detail} — wrong --model for this --resume path? "
+                    f"(allow_partial=True to force a partial load)")
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "partial restore from %s: %s; unmatched leaves keep their "
+                "initialization", step_dir, detail)
 
         def rebuild(path, x):
             key = param_path(path)
@@ -319,6 +350,19 @@ def _assemble_sharded(arrays_dir: str, meta: dict, sharding) -> jax.Array:
         del block
     pieces = [placed[device] for device in index_map]
     return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
+
+
+def split_resume_path(path: str) -> tuple[str, int | None]:
+    """Parse a ``--resume`` value into (checkpoint root, explicit step|None).
+
+    ``.../ck`` -> ("/.../ck", None); ``.../ck/step_00000007`` ->
+    ("/.../ck", 7). Single shared parser for every resume entry point.
+    """
+    target = path.rstrip("/")
+    m = _STEP_RE.match(os.path.basename(target))
+    if m:
+        return os.path.dirname(target) or ".", int(m.group(1))
+    return target, None
 
 
 def all_checkpoints(directory: str) -> list[int]:

@@ -134,6 +134,19 @@ class Trainer:
         self.checkpointer = (checkpoint_lib.Checkpointer(cfg.checkpoint_dir)
                              if cfg.checkpoint_dir else None)
         self.start_epoch = 0
+        self.resumed = False
+        if cfg.resume and self.checkpointer is None:
+            # --resume <path> without --checkpoint-dir: restore from (and
+            # keep saving into) that path instead of silently ignoring it.
+            if cfg.resume == "auto":
+                raise ValueError("--resume auto needs --checkpoint-dir (or "
+                                 "pass an explicit checkpoint path)")
+            root, _ = checkpoint_lib.split_resume_path(cfg.resume)
+            if not os.path.isdir(root):
+                # Validate BEFORE Checkpointer() mkdirs it: a typo'd path
+                # must not become a fresh empty checkpoint dir.
+                raise FileNotFoundError(f"--resume path not found: {cfg.resume}")
+            self.checkpointer = checkpoint_lib.Checkpointer(root)
         if cfg.resume and self.checkpointer:
             self._resume()
 
@@ -169,19 +182,13 @@ class Trainer:
 
     def _resume(self):
         """``--resume`` accepts 'auto', a checkpoint root, or a step_NNN dir."""
-        import re
-
         step = None
         directory = self.checkpointer.directory
         if self.cfg.resume not in ("auto", None):
-            target = self.cfg.resume.rstrip("/")
-            m = re.match(r"^step_(\d+)$", os.path.basename(target))
-            if m:  # specific step dir: resume exactly it
-                directory, step = os.path.dirname(target), int(m.group(1))
-            elif os.path.isdir(target):
-                directory = target
-            else:
-                raise FileNotFoundError(f"--resume path not found: {target}")
+            directory, step = checkpoint_lib.split_resume_path(self.cfg.resume)
+            if step is None and not os.path.isdir(directory):
+                raise FileNotFoundError(
+                    f"--resume path not found: {self.cfg.resume}")
             if directory != self.checkpointer.directory:
                 self.checkpointer = checkpoint_lib.Checkpointer(directory)
         if step is None:
@@ -191,6 +198,7 @@ class Trainer:
                 return
         self.state, extra = self.checkpointer.restore(self.state, step)
         self.start_epoch = int(extra.get("epoch", -1)) + 1
+        self.resumed = True
         log.info("resumed from step %d (epoch %d)", step, self.start_epoch)
 
     def _save(self, epoch: int):

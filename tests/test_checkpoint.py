@@ -117,6 +117,32 @@ def test_uncommitted_checkpoint_ignored(tmp_path, devices):
     assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 1
 
 
+def test_restore_rejects_foreign_checkpoint(tmp_path, devices):
+    """A checkpoint sharing zero parameters with the model must raise, not
+    silently evaluate/train a fresh init (wrong --model/--resume pairing)."""
+    import flax.linen as nn
+
+    mesh = mesh_lib.build_mesh({"data": 8})
+    state = _state(mesh)
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    ck.save(state, 1, block=True)
+
+    class Other(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(4, name="totally_different")(x)
+
+    tx, _ = optim.build_optimizer(Config(), steps_per_epoch=10)
+    other = train_loop.create_train_state(
+        Other(), tx, (jnp.zeros((2, 8), jnp.float32),), mesh,
+        sharding_lib.strategy_rules("dp", {}), seed=0)
+    with pytest.raises(ValueError, match="does not match this model"):
+        ck.restore(other)
+    # transfer-learning escape hatch: partial load downgrades to a warning
+    restored, _ = ck.restore(other, allow_partial=True)
+    assert restored is not None
+
+
 def test_prune_keeps_newest(tmp_path, devices):
     mesh = mesh_lib.build_mesh({"data": 8})
     state = _state(mesh)
