@@ -142,7 +142,16 @@ class Checkpointer:
                 os.replace(flist + ".tmp", flist)
             if distributed.is_main_process():
                 if multihost and not self._await_hosts(attempt_dir, nproc):
-                    return  # a host died mid-save: leave uncommitted
+                    import logging
+
+                    # A host died or stalled mid-save: leave uncommitted,
+                    # but NEVER silently — the operator must know --resume
+                    # will fall back to an older step.
+                    logging.getLogger(__name__).error(
+                        "checkpoint step %d NOT committed: not every host "
+                        "finished writing within the timeout (attempt left "
+                        "at %s)", step, attempt_dir)
+                    return
                 manifest = {
                     "step": step,
                     "extra": extra or {},

@@ -290,10 +290,27 @@ def build_dataset(name: str, data_path: str | None, train: bool, *,
     if name in ("imagenet", "imagenet1k"):
         if data_path:
             split = os.path.join(data_path, "train" if train else "val")
-            root = split if os.path.isdir(split) else data_path
-            if os.path.isdir(root):
-                return FolderDataset(root, train=train, image_size=image_size,
-                                     seed=seed)
+            if os.path.isdir(split):
+                root = split
+            elif os.path.isdir(data_path):
+                # Flat tree (class dirs at the root) or a missing val/
+                # split: fall back to the usable train images — loudly,
+                # because for eval that means scoring on training data.
+                train_split = os.path.join(data_path, "train")
+                root = (train_split
+                        if not train and os.path.isdir(train_split)
+                        else data_path)
+                if not train:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "no val/ split under %r; evaluation will run on "
+                        "the SAME images as training", data_path)
+            else:
+                raise FileNotFoundError(
+                    f"--data-path {data_path!r} does not exist")
+            return FolderDataset(root, train=train, image_size=image_size,
+                                 seed=seed)
         return SyntheticImageDataset(1281167 if train else 50000, image_size, 1000, seed)
     if name in ("lm", "synthetic_lm", "openwebtext"):
         if data_path and os.path.isfile(data_path):

@@ -1,0 +1,98 @@
+"""fp16 GradScaler parity path (SURVEY.md §2a #6 / §2b N6).
+
+bf16 is the TPU-native AMP replacement (no scaler); fp16 keeps exact
+``torch.cuda.amp.GradScaler`` semantics — scale, unscale, skip-on-overflow,
+backoff/growth — inside the compiled step. These were implemented in round 1
+but never test-covered.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.core import (
+    mesh as mesh_lib, optim, precision as precision_lib, train_loop)
+from pytorch_distributed_training_example_tpu.data import prefetch
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.parallel import (
+    sharding as sharding_lib)
+from pytorch_distributed_training_example_tpu.utils.config import Config
+
+
+def test_policy_table():
+    assert precision_lib.needs_loss_scaling(precision_lib.get_policy("fp16"))
+    for name in ("fp32", "bf16", "pure_bf16"):
+        assert not precision_lib.needs_loss_scaling(
+            precision_lib.get_policy(name))
+    with pytest.raises(ValueError, match="unknown precision"):
+        precision_lib.get_policy("fp8")
+
+
+def test_scaler_backoff_and_growth():
+    s = precision_lib.ScalerState.create(init_scale=1024.0,
+                                         growth_interval=2)
+    s = s.update(jnp.asarray(False))            # overflow -> halve
+    assert float(s.scale) == 512.0 and int(s.growth_tracker) == 0
+    s = s.update(jnp.asarray(True))
+    s = s.update(jnp.asarray(True))             # 2 finite steps -> double
+    assert float(s.scale) == 1024.0 and int(s.growth_tracker) == 0
+
+
+def _fp16_state_and_step(grad_accum=1, lr=1e-3):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    policy = precision_lib.get_policy("fp16")
+    bundle = registry.create_model("llama_tiny", seq_len=32,
+                                   dtype=policy.compute_dtype,
+                                   param_dtype=policy.param_dtype)
+    cfg = Config(lr=lr, warmup_epochs=0.0, optimizer="sgd", grad_clip=0.0,
+                 weight_decay=0.0)
+    tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
+    rules = sharding_lib.strategy_rules("dp", bundle.rules)
+    state = train_loop.create_train_state(
+        bundle.module, tx, bundle.input_template, mesh, rules, seed=0,
+        scaler=precision_lib.ScalerState.create())
+    step = jax.jit(train_loop.make_train_step(
+        train_loop.get_task("lm"), grad_accum), donate_argnums=0)
+    return mesh, state, step
+
+
+def _lm_batch(n=16, seed=0):
+    r = np.random.RandomState(seed)
+    toks = r.randint(0, 512, (n, 33)).astype(np.int32)
+    return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+@pytest.mark.parametrize("grad_accum", [1, 4])
+def test_fp16_trains_finite_with_scaler(devices, grad_accum):
+    mesh, state, step = _fp16_state_and_step(grad_accum)
+    with mesh_lib.use_mesh(mesh):
+        sh = mesh_lib.batch_sharding(mesh)
+        for i in range(3):
+            state, m = step(state, prefetch.shard_batch(_lm_batch(seed=i), sh))
+        m = {k: float(v) for k, v in jax.device_get(m).items()}
+    assert np.isfinite(m["loss"])
+    assert m["grads_finite"] == 1.0
+    assert m["loss_scale"] == 2.0**15  # untouched while finite
+
+
+def test_fp16_overflow_skips_update_and_backs_off(devices):
+    """GradScaler.step parity: on overflow params AND opt state hold, the
+    scale halves, and the step counter still advances."""
+    mesh, state, step = _fp16_state_and_step()
+    # A scaled loss at 2^15 over fp16's max (~65504) overflows the backward.
+    huge = jax.tree.map(
+        lambda p: (p * 1e4).astype(p.dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, state.params)
+    state = state.replace(params=huge)
+    params_before = jax.device_get(state.params)
+    with mesh_lib.use_mesh(mesh):
+        sh = mesh_lib.batch_sharding(mesh)
+        state, m = step(state, prefetch.shard_batch(_lm_batch(), sh))
+    m = {k: float(v) for k, v in jax.device_get(m).items()}
+    assert m["grads_finite"] == 0.0
+    assert m["loss_scale"] == 2.0**14  # backed off
+    for a, b in zip(jax.tree.leaves(params_before),
+                    jax.tree.leaves(jax.device_get(state.params))):
+        np.testing.assert_array_equal(a, b)  # update skipped
+    assert int(jax.device_get(state.step)) == 1  # schedule still advances

@@ -1,0 +1,74 @@
+"""Guard-rail and observability utilities (SURVEY.md §5): watchdog, timeout
+blocking, metric logging — small pieces the trainer leans on every step."""
+
+import json
+import logging
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.utils import (
+    logging as log_lib, metrics as metrics_lib, watchdog as wd)
+
+
+def test_watchdog_fires_and_recovers(caplog):
+    w = wd.Watchdog(timeout_s=0.2).start()
+    try:
+        with caplog.at_level(logging.ERROR, logger="pdtx"):
+            time.sleep(0.6)  # no beats -> must fire at least once
+        assert any("watchdog" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.ERROR, logger="pdtx"):
+            for _ in range(8):  # regular beats -> silent
+                w.beat()
+                time.sleep(0.05)
+        assert not caplog.records
+    finally:
+        w.stop()
+
+
+def test_block_with_timeout_passes_and_raises():
+    x = jnp.ones((4,)) * 2
+    wd.block_until_ready_with_timeout(x, timeout_s=30)
+
+    class Never:
+        def block_until_ready(self):
+            time.sleep(60)
+
+    with pytest.raises(TimeoutError, match="not ready"):
+        wd.block_until_ready_with_timeout(Never(), timeout_s=0.3)
+
+
+def test_metric_logger_jsonl_roundtrip(tmp_path):
+    path = tmp_path / "m" / "metrics.jsonl"
+    ml = log_lib.MetricLogger(str(path))
+    ml.write(kind="train", step=1, loss=2.5)
+    ml.write(kind="eval", loss=np.float32(1.25))  # numpy scalars serialize
+    ml.close()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows[0]["kind"] == "train" and rows[0]["loss"] == 2.5
+    assert rows[1]["loss"] == 1.25 and "time" in rows[1]
+
+
+def test_average_meter_and_throughput():
+    m = log_lib.AverageMeter("loss")
+    m.update(2.0)
+    m.update(4.0, n=3)
+    assert m.avg == pytest.approx(3.5)
+    t = log_lib.Throughput(warmup_steps=1)
+    t.update(10)          # warmup step sets t0
+    time.sleep(0.05)
+    t.update(10)
+    assert 0 < t.rate < 10_000
+
+
+def test_mfu_accounting():
+    # 1000 img/s at 4.09 GFLOP fwd => 3x fwd+bwd = 12.27 TF/s achieved.
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+    mfu = metrics_lib.mfu(1000.0, 4.09e9, device=FakeDev())
+    assert mfu == pytest.approx(3 * 4.09e12 / 197e12)
+    assert metrics_lib.peak_hbm_gbps(FakeDev()) == 819.0
