@@ -48,6 +48,8 @@ class Watchdog:
 
     def stop(self):
         self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)  # no late fires after stop()
 
     def _run(self):
         while not self._stop.wait(min(self.timeout_s / 4, 30.0)):

@@ -14,19 +14,33 @@ from pytorch_distributed_training_example_tpu.utils import (
 
 
 def test_watchdog_fires_and_recovers(caplog):
-    w = wd.Watchdog(timeout_s=0.2).start()
+    # Generous windows + deadline polling: the suite runs on a contended
+    # single-core box where daemon-thread scheduling can lag.
+    w = wd.Watchdog(timeout_s=0.5).start()
     try:
         with caplog.at_level(logging.ERROR, logger="pdtx"):
-            time.sleep(0.6)  # no beats -> must fire at least once
+            deadline = time.monotonic() + 15.0
+            while (not any("watchdog" in r.message for r in caplog.records)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)  # no beats -> must fire eventually
         assert any("watchdog" in r.message for r in caplog.records)
-        caplog.clear()
-        with caplog.at_level(logging.ERROR, logger="pdtx"):
-            for _ in range(8):  # regular beats -> silent
-                w.beat()
-                time.sleep(0.05)
-        assert not caplog.records
     finally:
         w.stop()
+
+    # Heartbeats keep it silent over a window long enough for the idle
+    # check (every timeout/4 = 0.5s) to run at least once; the 2s timeout
+    # tolerates scheduler stalls on a loaded box without re-flaking.
+    w2 = wd.Watchdog(timeout_s=2.0).start()
+    try:
+        caplog.clear()
+        with caplog.at_level(logging.ERROR, logger="pdtx"):
+            deadline = time.monotonic() + 1.2
+            while time.monotonic() < deadline:
+                w2.beat()
+                time.sleep(0.02)
+        assert not caplog.records
+    finally:
+        w2.stop()
 
 
 def test_block_with_timeout_passes_and_raises():
