@@ -13,34 +13,54 @@ from pytorch_distributed_training_example_tpu.utils import (
     logging as log_lib, metrics as metrics_lib, watchdog as wd)
 
 
-def test_watchdog_fires_and_recovers(caplog):
-    # Generous windows + deadline polling: the suite runs on a contended
-    # single-core box where daemon-thread scheduling can lag.
-    w = wd.Watchdog(timeout_s=0.5).start()
+class _Capture(logging.Handler):
+    """Handler attached straight to the 'pdtx' logger: trainer tests run
+    setup_logging() which sets propagate=False, so caplog's root-logger
+    handler misses watchdog records inside the full suite."""
+
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_watchdog_fires_and_recovers():
+    logger = logging.getLogger("pdtx")
+    cap = _Capture()
+    logger.addHandler(cap)
+    old_level = logger.level
+    logger.setLevel(logging.ERROR)
     try:
-        with caplog.at_level(logging.ERROR, logger="pdtx"):
+        # Generous windows + deadline polling: the suite runs on a
+        # contended single-core box where thread scheduling can lag.
+        w = wd.Watchdog(timeout_s=0.5).start()
+        try:
             deadline = time.monotonic() + 15.0
-            while (not any("watchdog" in r.message for r in caplog.records)
+            while (not any("watchdog" in r.getMessage() for r in cap.records)
                    and time.monotonic() < deadline):
                 time.sleep(0.05)  # no beats -> must fire eventually
-        assert any("watchdog" in r.message for r in caplog.records)
-    finally:
-        w.stop()
+            assert any("watchdog" in r.getMessage() for r in cap.records)
+        finally:
+            w.stop()
 
-    # Heartbeats keep it silent over a window long enough for the idle
-    # check (every timeout/4 = 0.5s) to run at least once; the 2s timeout
-    # tolerates scheduler stalls on a loaded box without re-flaking.
-    w2 = wd.Watchdog(timeout_s=2.0).start()
-    try:
-        caplog.clear()
-        with caplog.at_level(logging.ERROR, logger="pdtx"):
+        # Heartbeats keep it silent over a window long enough for the idle
+        # check (every timeout/4 = 0.5s) to run at least once; the 2s
+        # timeout tolerates scheduler stalls without re-flaking.
+        w2 = wd.Watchdog(timeout_s=2.0).start()
+        try:
+            cap.records.clear()
             deadline = time.monotonic() + 1.2
             while time.monotonic() < deadline:
                 w2.beat()
                 time.sleep(0.02)
-        assert not caplog.records
+            assert not cap.records
+        finally:
+            w2.stop()
     finally:
-        w2.stop()
+        logger.removeHandler(cap)
+        logger.setLevel(old_level)
 
 
 def test_block_with_timeout_passes_and_raises():
