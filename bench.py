@@ -128,9 +128,18 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
         peak_bw = metrics_lib.peak_hbm_gbps()
         intensity = flops_step / bytes_step
         ridge = metrics_lib.peak_flops_per_chip() / (peak_bw * 1e9)
+        # "bytes accessed" counts LOGICAL operand bytes; fused reads are
+        # double-counted, so bytes/time is an UPPER BOUND on real HBM
+        # traffic rate and can exceed the physical peak. Name the field for
+        # what it is and carry the source tag, so the artifact is
+        # self-describing (ADVICE r2 / VERDICT r2 #6).
+        modeled_gbps = bytes_step / step_s / 1e9
         roofline = {
             "hbm_bytes_per_step": round(bytes_step / 1e9, 3),
-            "achieved_hbm_gbps": round(bytes_step / step_s / 1e9, 1),
+            "bytes_source": "xla_cost_model_upper_bound",
+            "modeled_hbm_gbps": round(modeled_gbps, 1),
+            "modeled_bw_fraction_of_peak": round(
+                min(modeled_gbps / peak_bw, 1.0), 3),
             "peak_hbm_gbps": peak_bw,
             "xla_flops_per_step": round(flops_step / 1e12, 3),
             "arithmetic_intensity": round(intensity, 1),

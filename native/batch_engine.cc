@@ -65,6 +65,12 @@ static inline double next_uniform(uint64_t& state) {
   return static_cast<double>(state >> 11) * (1.0 / 9007199254740992.0);
 }
 
+// Per-sample RNG stream, keyed by the DATASET index (not chunk position):
+// augmentation is invariant to batch size / worker count / job chunking.
+static inline uint64_t sample_rng(uint64_t seed, int64_t dataset_index) {
+  return mix(seed ^ (0x517cc1b7ULL * static_cast<uint64_t>(dataset_index + 1)));
+}
+
 struct Engine {
   // dataset description
   const uint8_t* u8_data = nullptr;    // image mode
@@ -122,7 +128,7 @@ struct Engine {
     float* out = static_cast<float*>(job.out);
     const int64_t sample = out_size * out_size * 3;
     for (size_t i = 0; i < job.indices.size(); ++i) {
-      uint64_t rng = job.seed ^ (0x517cc1b7ULL * (i + 1));
+      uint64_t rng = sample_rng(job.seed, job.indices[i]);
       if (!decode_jpeg(paths[job.indices[i]], out + i * sample, rng)) {
         // Failed decode: emit the dataset mean (zeros after normalize) so the
         // batch shape stays valid; count it for the caller to inspect.
@@ -318,7 +324,7 @@ struct Engine {
       int dy = 0, dx = 0;
       bool flip = false;
       if (augment) {
-        uint64_t r = mix(job.seed ^ (0x517cc1b7ULL * (i + 1)));
+        uint64_t r = sample_rng(job.seed, job.indices[i]);
         dy = static_cast<int>(r % (2 * pad + 1)) - pad;
         dx = static_cast<int>((r >> 16) % (2 * pad + 1)) - pad;
         flip = ((r >> 32) & 1) != 0;

@@ -46,6 +46,7 @@ from pytorch_distributed_training_example_tpu.parallel.sharding import param_pat
 COMMIT_FILE = "COMMIT"
 MANIFEST_FILE = "manifest.json"
 SAVING_SUFFIX = ".saving"  # in-progress attempt dirs (never resume-eligible)
+OLD_SUFFIX = ".old"  # prior committed dir set aside during a re-save swap
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
@@ -72,6 +73,27 @@ class Checkpointer:
         self._thread: threading.Thread | None = None
         if distributed.is_main_process():
             os.makedirs(directory, exist_ok=True)
+            self._recover_interrupted_replace()
+        if jax.process_count() > 1:
+            # Non-main hosts must not race latest_checkpoint() against the
+            # heal above: a step_X.old-only directory would look empty to
+            # them and desynchronize --resume across hosts. __init__ runs on
+            # the main thread (same thread as train-step collectives).
+            distributed.barrier("ckpt_init_recover")
+
+    def _recover_interrupted_replace(self):
+        """Heal a crash inside save()'s re-save swap: a ``step_X.old`` dir
+        without its ``step_X`` means the crash hit between the two renames —
+        the set-aside copy is the committed checkpoint; restore its name."""
+        for name in os.listdir(self.directory):
+            if not name.endswith(OLD_SUFFIX):
+                continue
+            old = os.path.join(self.directory, name)
+            base = os.path.join(self.directory, name[: -len(OLD_SUFFIX)])
+            if os.path.isdir(base):
+                shutil.rmtree(old, ignore_errors=True)  # swap had completed
+            else:
+                os.rename(old, base)
 
     # -- save ---------------------------------------------------------------
 
@@ -164,14 +186,23 @@ class Checkpointer:
                 # restore unions them with the manifest's own list.
                 with open(os.path.join(attempt_dir, MANIFEST_FILE), "w") as fh:
                     json.dump(manifest, fh)
-                # Swap attempt -> final. The only unprotected window is the
-                # rmtree+rename pair below (milliseconds, two syscalls) vs.
-                # the whole multi-GB write before this change.
-                if os.path.isdir(step_dir):
-                    shutil.rmtree(step_dir, ignore_errors=True)
-                os.rename(attempt_dir, step_dir)
-                with open(os.path.join(step_dir, COMMIT_FILE), "w") as fh:
+                # COMMIT is written INSIDE the attempt dir (whose .saving
+                # suffix keeps it resume-ineligible), so the rename below
+                # publishes a fully-committed dir in one atomic syscall.
+                # An existing committed dir for this step is renamed ASIDE,
+                # never rmtree'd before its replacement exists: a crash at
+                # any point leaves either the old or the new copy intact
+                # (the one-syscall gap between the two renames is healed by
+                # _recover_interrupted_replace at next startup).
+                with open(os.path.join(attempt_dir, COMMIT_FILE), "w") as fh:
                     fh.write(str(step))
+                old_dir = step_dir + OLD_SUFFIX
+                if os.path.isdir(step_dir):
+                    if os.path.isdir(old_dir):
+                        shutil.rmtree(old_dir, ignore_errors=True)
+                    os.rename(step_dir, old_dir)
+                os.rename(attempt_dir, step_dir)
+                shutil.rmtree(old_dir, ignore_errors=True)
                 self._prune()
 
         # attempt dir + rename + COMMIT marker is the atomicity boundary

@@ -63,7 +63,8 @@ class Trainer:
         self.train_data = datasets_lib.build_dataset(
             cfg.dataset, cfg.data_path, train=True, **data_kw)
         self.eval_data = datasets_lib.build_dataset(
-            cfg.dataset, cfg.data_path, train=False, **data_kw)
+            cfg.dataset, cfg.data_path, train=False,
+            require_split=cfg.evaluate, **data_kw)
         if isinstance(self.train_data, datasets_lib.TokenFileDataset):
             # Out-of-vocab ids don't crash an embedding gather — they clamp
             # and train to NaN. Fail loudly on a wrong model/data pairing.
@@ -157,8 +158,13 @@ class Trainer:
 
         self.fault_inject = None
         if cfg.fault_inject:  # "rank:step" — SURVEY.md §5 fault injector
-            r, s = cfg.fault_inject.split(":")
-            self.fault_inject = (int(r), int(s))
+            try:
+                r, s = cfg.fault_inject.split(":")
+                self.fault_inject = (int(r), int(s))
+            except ValueError:
+                raise ValueError(
+                    f"--fault-inject expects 'rank:step' (two integers "
+                    f"separated by a colon), got {cfg.fault_inject!r}") from None
 
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.state.params))
         log.info("model=%s params=%.2fM devices=%d mesh=%s strategy=%s precision=%s",

@@ -280,8 +280,13 @@ class TokenFileDataset:
 
 def build_dataset(name: str, data_path: str | None, train: bool, *,
                   image_size: int = 224, seq_len: int = 1024, seed: int = 0,
-                  vocab_size: int = 50257):
-    """Dataset factory used by main.py; falls back to synthetic when no data dir."""
+                  vocab_size: int = 50257, require_split: bool = False):
+    """Dataset factory used by main.py; falls back to synthetic when no data dir.
+
+    ``require_split=True`` (eval-only mode) refuses the train-images fallback
+    when ``val/`` is missing — scoring the training set must never be
+    reported as "the evaluation metric" silently (ADVICE r2).
+    """
     name = name.lower()
     if name == "cifar10":
         if data_path and os.path.isdir(os.path.join(data_path, "cifar-10-batches-py")):
@@ -300,6 +305,14 @@ def build_dataset(name: str, data_path: str | None, train: bool, *,
                 root = (train_split
                         if not train and os.path.isdir(train_split)
                         else data_path)
+                if not train and require_split and root == train_split:
+                    # Only the TRAIN-IMAGES fallback is refused; a flat tree
+                    # (class dirs at the root, e.g. --data-path .../val
+                    # pointing straight at the eval split) stays valid.
+                    raise FileNotFoundError(
+                        f"--evaluate: no val/ split under {data_path!r} — "
+                        "refusing to score the training images as the "
+                        "evaluation metric")
                 if not train:
                     import logging
 

@@ -287,9 +287,11 @@ class NativeDataLoader:
             for j in range(0, len(bi), per):
                 cid = self._next_id
                 self._next_id += 1
+                # Epoch-only seed: the engine keys per-sample RNG on the
+                # DATASET index, so augmentation is reproducible across
+                # --workers / chunking / batch-size choices.
                 self.engine.submit(cid, np.ascontiguousarray(bi[j:j + per]),
-                                   buf[j:],
-                                   seed=(self.epoch << 32) ^ (b << 8) ^ (j // per))
+                                   buf[j:], seed=self.epoch)
                 ids.append(cid)
             pending[b] = (ids, bi)
 

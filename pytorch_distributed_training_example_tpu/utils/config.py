@@ -54,6 +54,7 @@ class Config:
     checkpoint_dir: str | None = None
     checkpoint_every_epochs: int = 1
     resume: str | None = None  # path | "auto"
+    evaluate: bool = False  # eval-only mode (main.py --evaluate)
     seed: int = 0
     # profiling
     profile_steps: str | None = None  # "start:stop" step range

@@ -143,6 +143,34 @@ def test_restore_rejects_foreign_checkpoint(tmp_path, devices):
     assert restored is not None
 
 
+def test_resave_same_step_survives_crash_window(tmp_path, devices):
+    """Re-saving an already-committed step must never pass through a state
+    where NO committed copy of that step exists (ADVICE r2): the old dir is
+    set aside as step_X.old, and a crash between the two renames is healed
+    at the next Checkpointer construction."""
+    mesh = mesh_lib.build_mesh({"data": 8})
+    state = _state(mesh)
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    ck.save(state, 5, block=True)
+    # re-save the same step: still committed and restorable afterwards
+    ck.save(state, 5, block=True)
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 5
+    restored, _ = ck.restore(_state(mesh, seed=9))
+    _assert_state_equal(state, restored)
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.endswith(ckpt_lib.OLD_SUFFIX)]
+
+    # simulate the crash landing between rename(step->old) and
+    # rename(attempt->step): only the .old copy remains
+    step_dir = os.path.join(str(tmp_path), "step_00000005")
+    os.rename(step_dir, step_dir + ckpt_lib.OLD_SUFFIX)
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) is None
+    ck2 = ckpt_lib.Checkpointer(str(tmp_path))  # startup heals it
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 5
+    restored, _ = ck2.restore(_state(mesh, seed=11))
+    _assert_state_equal(state, restored)
+
+
 def test_prune_keeps_newest(tmp_path, devices):
     mesh = mesh_lib.build_mesh({"data": 8})
     state = _state(mesh)

@@ -52,6 +52,29 @@ def test_augment_deterministic_per_seed():
     eng.close()
 
 
+def test_augment_invariant_to_chunking():
+    """Per-sample RNG is keyed on the DATASET index, so splitting a batch
+    across jobs (different --workers / chunk sizes) must not change the
+    augmentation (ADVICE r2: chunk-relative seeding was not reproducible)."""
+    imgs = np.random.RandomState(5).randint(0, 256, (6, 8, 8, 3), np.uint8)
+    eng = nl.NativeBatchEngine.image(imgs, [0.5] * 3, [0.25] * 3, augment=True)
+    whole = np.empty((6, 8, 8, 3), np.float32)
+    split = np.empty_like(whole)
+    eng.submit(0, np.arange(6), whole, seed=7)
+    eng.submit(1, np.arange(3), split[:3], seed=7)        # chunk 1
+    eng.submit(2, np.arange(3, 6), split[3:], seed=7)     # chunk 2
+    for i in range(3):
+        eng.wait(i)
+    np.testing.assert_array_equal(whole, split)
+    # reordered indices still get their own per-index stream
+    perm = np.array([3, 1, 5, 0, 4, 2])
+    reord = np.empty_like(whole)
+    eng.submit(3, perm, reord, seed=7)
+    eng.wait(3)
+    np.testing.assert_array_equal(reord, whole[perm])
+    eng.close()
+
+
 def test_native_dataloader_iterates():
     imgs = np.random.RandomState(3).randint(0, 256, (40, 8, 8, 3), np.uint8)
     labels = np.arange(40) % 10
