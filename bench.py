@@ -69,7 +69,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                                    image_size=image_size, seq_len=seq_len,
                                    dtype=policy.compute_dtype,
                                    param_dtype=policy.param_dtype, remat=remat,
-                                   attn_impl=attn_impl)
+                                   attn_impl=attn_impl,
+                                   logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
     state = train_loop.create_train_state(bundle.module, tx,

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""GPT-2 MFU sweep (VERDICT r2 #2): batch x remat x attn-impl x flash blocks.
+
+Runs the same compiled-scan train-step harness as bench.py over a grid of
+configs on the real chip and records every row (including OOM failures) to
+LM_SWEEP.json. The best row is the candidate for bench.py's LM headline and
+benchmarks/golden.json.
+
+Usage:
+    python benchmarks/lm_sweep.py [--out LM_SWEEP.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+
+def run_row(bench_mod, flash_mod, *, batch, seq_len, remat, attn_impl,
+            block_q=None, block_kv=None, steps=10, warmup=4):
+    """One sweep point; returns the bench row dict or an error record."""
+    label = {"per_chip_batch": batch, "seq_len": seq_len, "remat": remat,
+             "attn_impl": attn_impl,
+             "block_q": block_q or flash_mod.DEFAULT_BLOCK_Q,
+             "block_kv": block_kv or flash_mod.DEFAULT_BLOCK_KV}
+    orig = flash_mod.flash_attention
+    try:
+        if block_q or block_kv:
+            # attention() calls flash_attention() with default blocks; pin
+            # the sweep's blocks without plumbing a new argument everywhere.
+            wrapped = functools.partial(
+                orig, block_q=block_q or flash_mod.DEFAULT_BLOCK_Q,
+                block_kv=block_kv or flash_mod.DEFAULT_BLOCK_KV)
+            flash_mod.flash_attention = wrapped
+        t0 = time.perf_counter()
+        row = bench_mod.bench("gpt2", per_chip_batch=batch, steps=steps,
+                              warmup=warmup, precision="bf16",
+                              seq_len=seq_len, remat=remat,
+                              attn_impl=attn_impl, quiet=True)
+        label.update(mfu=row["extra"]["mfu"], step_ms=row["extra"]["step_ms"],
+                     seq_per_sec_chip=row["value"],
+                     wall_s=round(time.perf_counter() - t0, 1), ok=True)
+    except Exception as e:  # OOM rows are data, not crashes
+        msg = str(e)
+        label.update(ok=False,
+                     error=("OOM" if "RESOURCE_EXHAUSTED" in msg
+                            or "Out of memory" in msg else msg[:200]))
+    finally:
+        flash_mod.flash_attention = orig
+    print(json.dumps(label), file=sys.stderr, flush=True)
+    return label
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="LM_SWEEP.json")
+    p.add_argument("--quick", action="store_true",
+                   help="batch/remat grid only (skip block + S=2048 axes)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    import bench as bench_mod
+    from pytorch_distributed_training_example_tpu.ops import (
+        flash_attention as flash_mod)
+
+    rows = []
+    # Axis 1: per-chip batch x remat at S=1024, flash attention.
+    for batch in (8, 16, 32, 64):
+        for remat in (False, True):
+            rows.append(run_row(bench_mod, flash_mod, batch=batch,
+                                seq_len=1024, remat=remat, attn_impl="flash"))
+    # Axis 2: XLA attention at the best-looking batches (flash vs XLA).
+    for batch in (16, 32):
+        rows.append(run_row(bench_mod, flash_mod, batch=batch, seq_len=1024,
+                            remat=False, attn_impl="xla"))
+    if not args.quick:
+        # Axis 3: flash block sizes at the best batch (S=1024 -> blocks
+        # divide 1024; 512 is the default).
+        for bq, bkv in ((256, 256), (256, 512), (512, 256), (1024, 512),
+                        (512, 1024), (1024, 1024)):
+            rows.append(run_row(bench_mod, flash_mod, batch=32, seq_len=1024,
+                                remat=False, attn_impl="flash",
+                                block_q=bq, block_kv=bkv))
+        # Axis 4: S=2048 (longer sequence shifts attention share upward).
+        for batch in (4, 8, 16):
+            rows.append(run_row(bench_mod, flash_mod, batch=batch,
+                                seq_len=2048, remat=False, attn_impl="flash"))
+
+    ok_rows = [r for r in rows if r.get("ok")]
+    best = max(ok_rows, key=lambda r: r["mfu"]) if ok_rows else None
+    out = {
+        "sweep": "gpt2_mfu",
+        "device": jax.devices()[0].device_kind,
+        "target_mfu": 0.55,
+        "best": best,
+        "rows": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"best": best, "n_rows": len(rows),
+                      "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    raise SystemExit(main())

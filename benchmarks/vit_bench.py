@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""ViT-B/16 perf rows (VERDICT r2 #3 — BASELINE.json configs[2], previously
+correctness-only). Runs the standard bench train-step harness at a small
+per-chip batch sweep and records BENCH_VIT.json.
+
+ViT-B/16 at 224px has 197 tokens/image — not a multiple of 512, so the
+Pallas flash kernel is ineligible by design (ops/attention._flash_eligible)
+and attention runs the fused XLA path; the artifact records rows for
+``auto`` (XLA) attention across batches.
+
+    python benchmarks/vit_bench.py [--out BENCH_VIT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_VIT.json")
+    p.add_argument("--batches", default="64,128,256")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from bench import bench
+
+    rows = []
+    for b in [int(x) for x in args.batches.split(",")]:
+        t0 = time.perf_counter()
+        try:
+            r = bench("vit_b16", per_chip_batch=b, steps=10, warmup=4,
+                      precision="bf16", quiet=True)
+            rows.append({"per_chip_batch": b, "value": r["value"],
+                         "unit": r["unit"], "mfu": r["extra"]["mfu"],
+                         "step_ms": r["extra"]["step_ms"],
+                         "roofline": r["extra"].get("roofline", {}),
+                         "wall_s": round(time.perf_counter() - t0, 1),
+                         "ok": True})
+        except Exception as e:
+            msg = str(e)
+            rows.append({"per_chip_batch": b, "ok": False,
+                         "error": ("OOM" if "RESOURCE_EXHAUSTED" in msg
+                                   else msg[:200])})
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    ok = [r for r in rows if r["ok"]]
+    best = max(ok, key=lambda r: r["mfu"]) if ok else None
+    out = {"metric": "vit_b16_imagenet_train_throughput",
+           "device": jax.devices()[0].device_kind,
+           "best": best, "rows": rows}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"best": best, "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,7 +29,14 @@ class Policy:
 
     param_dtype: Any = jnp.float32   # master copy held in the train state
     compute_dtype: Any = jnp.bfloat16  # matmul/conv inputs (MXU-native)
-    output_dtype: Any = jnp.float32  # logits / loss accumulation
+    output_dtype: Any = jnp.float32  # loss accumulation
+    #: dtype LM logits are *stored* in between the vocab matmul and the loss.
+    #: The loss always accumulates in fp32 (metrics.cross_entropy upcasts
+    #: per-element inside its fusions); bf16 storage only re-rounds values the
+    #: bf16 vocab matmul already rounded, while halving-to-quartering the
+    #: largest activation tensor's HBM traffic ([B,S,50257] for GPT-2 —
+    #: measured 18.5% of the v5e step, see LM_SWEEP.json/PROFILE notes).
+    logits_dtype: Any = jnp.float32
 
     def cast_to_compute(self, tree):
         return _cast_floating(tree, self.compute_dtype)
@@ -55,11 +62,13 @@ POLICIES: dict[str, Policy] = {
     # Reference's fp32 baseline path (no autocast).
     "fp32": Policy(jnp.float32, jnp.float32, jnp.float32),
     # The TPU-native AMP equivalent: fp32 master params, bf16 compute.
-    "bf16": Policy(jnp.float32, jnp.bfloat16, jnp.float32),
+    "bf16": Policy(jnp.float32, jnp.bfloat16, jnp.float32, jnp.bfloat16),
     # Fully bf16 (params too) — halves HBM for params; fine for inference
     # and large-model training with care.
-    "pure_bf16": Policy(jnp.bfloat16, jnp.bfloat16, jnp.float32),
-    # fp16 with dynamic loss scaling — GPU-style AMP parity path.
+    "pure_bf16": Policy(jnp.bfloat16, jnp.bfloat16, jnp.float32, jnp.bfloat16),
+    # fp16 with dynamic loss scaling — GPU-style AMP parity path (logits
+    # stay fp32: fp16's narrow exponent near softmax is exactly what the
+    # scaler exists to protect against).
     "fp16": Policy(jnp.float32, jnp.float16, jnp.float32),
 }
 

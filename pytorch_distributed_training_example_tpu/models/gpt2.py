@@ -106,6 +106,7 @@ class GPT2(nn.Module):
     remat: bool = False
     attn_impl: str = "auto"
     sp: bool = False
+    logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -131,9 +132,12 @@ class GPT2(nn.Module):
                           self.sp, name=f"block_{i}")(x, train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
-        # Weight-tied LM head (GPT-2 convention).
+        # Weight-tied LM head (GPT-2 convention). flax's attend promotes both
+        # operands to the module dtype (bf16 under the bf16 policy), so the
+        # matmul output is already bf16-rounded; logits_dtype only decides
+        # what lands in HBM (metrics.cross_entropy upcasts fp32 per-element).
         logits = emb.attend(x.astype(self.param_dtype))
-        return logits.astype(jnp.float32)
+        return logits.astype(self.logits_dtype)
 
 
 #: Tensor-parallel rule table (path regex -> PartitionSpec). AUTO_FSDP

@@ -148,6 +148,7 @@ class Llama(nn.Module):
     attn_impl: str = "auto"
     num_experts: int = 0
     sp: bool = False
+    logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
     @property
     def head_dim(self):
@@ -193,7 +194,7 @@ class Llama(nn.Module):
                     name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(self.logits_dtype)
 
 
 TP_RULES = (

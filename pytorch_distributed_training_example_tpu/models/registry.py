@@ -42,13 +42,14 @@ def list_models() -> list[str]:
 def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  seq_len: int = 1024, dtype=jnp.bfloat16, param_dtype=jnp.float32,
                  remat: bool = False, sp: bool = False,
-                 attn_impl: str = "auto") -> ModelBundle:
+                 attn_impl: str = "auto",
+                 logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
     return _REGISTRY[name](
         num_classes=num_classes, image_size=image_size, seq_len=seq_len,
         dtype=dtype, param_dtype=param_dtype, remat=remat, sp=sp,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, logits_dtype=logits_dtype,
     )
 
 
@@ -99,53 +100,58 @@ def _lm_bundle(module, tp_rules, seq_len, n_params_fn):
 
 
 @register("gpt2")
-def _gpt2(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto", **_):
+def _gpt2(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
+          logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import gpt2
 
     module = gpt2.gpt2_124m(dtype=dtype, param_dtype=param_dtype, remat=remat,
                             max_seq_len=max(seq_len, 1024), sp=sp,
-                            attn_impl=attn_impl)
+                            attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
 
 
 @register("gpt2_tiny")
-def _gpt2_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto", **_):
+def _gpt2_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
+               logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import gpt2
 
     module = gpt2.gpt2_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
                             max_seq_len=max(seq_len, 256), sp=sp,
-                            attn_impl=attn_impl)
+                            attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
 
 
 @register("llama3_8b")
-def _llama3_8b(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto", **_):
+def _llama3_8b(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
+               logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama3_8b(dtype=dtype, param_dtype=param_dtype, remat=remat,
                              max_seq_len=max(seq_len, 8192), sp=sp,
-                             attn_impl=attn_impl)
+                             attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
 @register("llama_tiny")
-def _llama_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto", **_):
+def _llama_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
+                logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
                               max_seq_len=max(seq_len, 256), sp=sp,
-                              attn_impl=attn_impl)
+                              attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
 @register("llama_moe_tiny")
 def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False,
-                    attn_impl="auto", **_):
+                    attn_impl="auto", logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_moe_tiny(dtype=dtype, param_dtype=param_dtype,
                                   remat=remat, max_seq_len=max(seq_len, 256),
-                                  sp=sp, attn_impl=attn_impl)
+                                  sp=sp, attn_impl=attn_impl,
+                                  logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 

@@ -35,6 +35,17 @@ DEFAULT_BLOCK_KV = 512
 LSE_LANES = 8  # lse stored [B,H,S,8]: minor dims satisfy Mosaic tiling
 
 
+def _mxu(x):
+    """MXU operand dtype: bf16/fp32 as stored; fp16 upcast to fp32.
+
+    fp16's 5-bit exponent overflows on scale-multiplied gradients (the
+    GradScaler path multiplies do by up to 2^15+), and softmax probabilities
+    below 2^-24 flush to zero — so the fp16 AMP policy keeps kernel math in
+    fp32 while bf16 training uses native-dtype operands for MXU rate.
+    """
+    return x.astype(jnp.float32) if x.dtype == jnp.float16 else x
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                 sm_scale: float, causal: bool, block_q: int, block_kv: int):
     qi = pl.program_id(2)
@@ -54,9 +65,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)           # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)           # [bkv, D]
-        v = v_ref[0, 0].astype(jnp.float32)           # [bkv, D]
+        # MXU-native operands: dots take q/k/v in their stored dtype (bf16 in
+        # training) with fp32 accumulation via preferred_element_type — the
+        # FlashAttention-2 scheme. Upcasting operands to fp32 here measured
+        # ~20 TF/s on v5e (fp32 MXU rate); bf16 operands run ~2-3x faster.
+        # All softmax state (m, l, acc) stays fp32.
+        q = _mxu(q_ref[0, 0])                         # [bq, D]
+        k = _mxu(k_ref[0, 0])                         # [bkv, D]
+        v = _mxu(v_ref[0, 0])                         # [bkv, D]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bkv]
@@ -73,7 +89,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         p = jnp.exp(logits - m_new)                   # [bq, bkv]
         correction = jnp.exp(m_prev - m_new)          # [bq, 1]
         l_new = l_ref[:, :1] * correction + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * correction + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -153,10 +169,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Native-dtype matmul operands, fp32 accumulation (see _fwd_kernel).
+        q = _mxu(q_ref[0, 0])
+        k = _mxu(k_ref[0, 0])
+        v = _mxu(v_ref[0, 0])
+        do = _mxu(do_ref[0, 0])
         lse = lse_ref[0, 0, :, :1]               # [bq, 1]
         delta = delta_ref[0, 0, :, :1]           # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -168,7 +185,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)                     # [bq, bkv]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         acc_ref[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
 
@@ -195,10 +212,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Native-dtype matmul operands, fp32 accumulation (see _fwd_kernel).
+        q = _mxu(q_ref[0, 0])
+        k = _mxu(k_ref[0, 0])
+        v = _mxu(v_ref[0, 0])
+        do = _mxu(do_ref[0, 0])
         lse = lse_ref[0, 0, :, :1]               # [bq, 1]
         delta = delta_ref[0, 0, :, :1]           # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -209,11 +227,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                     # [bq, bkv]
         # dV += P^T dO
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         # dK += dS^T Q
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)

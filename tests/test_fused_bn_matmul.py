@@ -1,0 +1,63 @@
+"""Parity tests for the fused BN-apply/ReLU -> matmul -> BN-stats kernel
+(ops/fused_bn_matmul.py), interpret mode (CPU CI)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.ops import fused_bn_matmul as fbm
+
+
+def _ref(x, w, scale, bias, relu):
+    xf = x.astype(jnp.float32)
+    if scale is not None:
+        xf = xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    if relu:
+        xf = jnp.maximum(xf, 0.0)
+    y = xf.astype(x.dtype).astype(jnp.float32) @ w.astype(jnp.float32)
+    return y, jnp.mean(y, 0), jnp.var(y, 0)
+
+
+@pytest.mark.parametrize("affine,relu", [(False, False), (True, True)])
+def test_fused_matches_unfused(affine, relu):
+    r = np.random.RandomState(0)
+    N, K, C = 256, 128, 64
+    x = jnp.asarray(r.randn(N, K), jnp.float32)
+    w = jnp.asarray(r.randn(K, C) / np.sqrt(K), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * r.randn(1, K), jnp.float32) if affine else None
+    bias = jnp.asarray(0.1 * r.randn(1, K), jnp.float32) if affine else None
+
+    y, mean, var = fbm.bn_stats_matmul(x, w, scale, bias, relu=relu,
+                                       block_n=64, interpret=True)
+    ry, rmean, rvar = _ref(x, w, scale, bias, relu)
+    np.testing.assert_allclose(y, ry, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(mean, rmean, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(var, rvar, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_pads_odd_channels():
+    """Cout=64 pads to 128 lanes; zero columns must not leak into stats."""
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(128, 256), jnp.float32)
+    w = jnp.asarray(r.randn(256, 64) / 16.0, jnp.float32)
+    y, mean, var = fbm.bn_stats_matmul(x, w, relu=False, block_n=64,
+                                       interpret=True)
+    assert y.shape == (128, 64) and mean.shape == (64,)
+    ry, rmean, rvar = _ref(x, w, None, None, False)
+    np.testing.assert_allclose(mean, rmean, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(var, rvar, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_bf16_accumulates_fp32():
+    """bf16 inputs: stats come from the fp32 matmul accumulator, not the
+    rounded bf16 output."""
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(256, 128), jnp.bfloat16)
+    w = jnp.asarray(r.randn(128, 128) / 11.3, jnp.bfloat16)
+    y, mean, var = fbm.bn_stats_matmul(x, w, relu=True, block_n=128,
+                                       interpret=True)
+    assert y.dtype == jnp.bfloat16
+    xf = jnp.maximum(x.astype(jnp.float32), 0)
+    ryf = xf @ w.astype(jnp.float32)
+    np.testing.assert_allclose(mean, jnp.mean(ryf, 0), rtol=2e-2, atol=1e-2)
+    np.testing.assert_allclose(var, jnp.var(ryf, 0), rtol=5e-2, atol=1e-2)

@@ -96,3 +96,29 @@ def test_fp16_overflow_skips_update_and_backs_off(devices):
                     jax.tree.leaves(jax.device_get(state.params))):
         np.testing.assert_array_equal(a, b)  # update skipped
     assert int(jax.device_get(state.step)) == 1  # schedule still advances
+
+
+def test_bf16_logits_storage_matches_f32():
+    """bf16 logits_dtype (the bf16 policy's LM setting) only re-rounds what
+    the bf16 vocab matmul already rounded: the CE loss must match the
+    f32-stored-logits run closely, and the policy must request it."""
+    assert precision_lib.get_policy("bf16").logits_dtype == jnp.bfloat16
+    assert precision_lib.get_policy("fp16").logits_dtype == jnp.float32
+
+    mesh = mesh_lib.single_device_mesh()
+    losses = {}
+    for ld in (jnp.float32, jnp.bfloat16):
+        bundle = registry.create_model("gpt2_tiny", seq_len=32,
+                                       dtype=jnp.bfloat16,
+                                       param_dtype=jnp.float32,
+                                       logits_dtype=ld)
+        cfg = Config(lr=1e-3, warmup_epochs=0.0, optimizer="sgd")
+        tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
+        state = train_loop.create_train_state(
+            bundle.module, tx, bundle.input_template, mesh, (), seed=0)
+        step = jax.jit(train_loop.make_train_step(train_loop.get_task("lm")))
+        with mesh_lib.use_mesh(mesh):
+            _, m = step(state, prefetch.shard_batch(
+                _lm_batch(), mesh_lib.batch_sharding(mesh)))
+        losses[str(ld.__name__)] = float(m["loss"])
+    assert np.isclose(losses["float32"], losses["bfloat16"], rtol=2e-3), losses
