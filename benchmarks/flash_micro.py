@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Isolated flash-attention timing at LM shapes (fwd and fwd+bwd).
+
+Prints per-config: measured ms, attention-FLOPs, achieved TF/s and
+fraction-of-peak, flash kernel vs XLA dot-product attention. Informs the
+GPT-2 MFU ceiling analysis (LM_SWEEP.json).
+
+Timing idiom matches bench.py: N dependent iterations inside one
+``lax.scan`` under jit, synced by a host transfer of the carried scalar —
+``block_until_ready`` alone does not synchronize through the axon tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def attn_flops(B, H, S, D, causal=True, bwd=False):
+    """MAC-counted FLOPs for qk+pv; bwd adds recompute + dq/dk/dv dots."""
+    f = 2 * 2 * B * H * S * S * D  # qk and pv, 2 FLOPs per MAC
+    if causal:
+        f /= 2
+    return f * (3.5 if bwd else 1.0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--peak-tflops", type=float, default=197.0)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.ops import (
+        flash_attention as fa)
+    from pytorch_distributed_training_example_tpu.ops import (
+        attention as attn_lib)
+
+    def xla_attn(q, k, v, causal=True):
+        return attn_lib.dot_product_attention(q, k, v, causal=causal)
+
+    def timed(fn_one, q, k, v):
+        """ms per iteration of q <- fn_one(q, k, v), scanned."""
+        def body(qq, _):
+            return fn_one(qq, k, v), ()
+
+        @jax.jit
+        def run(q):
+            out, _ = jax.lax.scan(body, q, None, length=args.iters)
+            return jnp.float32(out[0, 0, 0, 0])
+
+        np.asarray(run(q))  # compile + warm
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run(q))
+            dt = min(dt, time.perf_counter() - t0)
+        return dt / args.iters * 1e3
+
+    rows = []
+    for (B, H, S, D) in ((16, 12, 1024, 64), (4, 12, 2048, 64),
+                         (2, 16, 4096, 128)):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+
+        for name, fn in (
+                ("flash", functools.partial(fa.flash_attention, causal=True)),
+                ("xla", xla_attn)):
+            ms_f = timed(fn, q, k, v)
+
+            def grad_step(qq, k, v, fn=fn):
+                g = jax.grad(
+                    lambda q3: jnp.sum(fn(q3, k, v).astype(jnp.float32)
+                                       ) * 1e-3)(qq)
+                return g.astype(qq.dtype)
+
+            ms_b = timed(grad_step, q, k, v)
+
+            for tag, ms, bwd in (("fwd", ms_f, False),
+                                 ("fwd+bwd", ms_b, True)):
+                fl = attn_flops(B, H, S, D, bwd=bwd)
+                tf = fl / (ms / 1e3) / 1e12
+                rows.append({"impl": name, "pass": tag, "B": B, "H": H,
+                             "S": S, "D": D, "ms": round(ms, 3),
+                             "tflops": round(tf, 1),
+                             "frac_peak": round(tf / args.peak_tflops, 3)})
+                print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"peak_tflops": args.peak_tflops, "rows": rows}, f,
+                      indent=1)
+    print(json.dumps({"rows": len(rows)}))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

@@ -6,8 +6,15 @@ configs on the real chip and records every row (including OOM failures) to
 LM_SWEEP.json. The best row is the candidate for bench.py's LM headline and
 benchmarks/golden.json.
 
+Each row runs in a FRESH SUBPROCESS: a row that OOMs (or wedges the remote
+compile helper) leaves the process unable to allocate for every later row —
+the first in-process sweep recorded spurious OOMs for configs that fit
+comfortably when run alone. The persistent compile cache keeps the per-row
+re-init cost to seconds.
+
 Usage:
     python benchmarks/lm_sweep.py [--out LM_SWEEP.json] [--quick]
+    python benchmarks/lm_sweep.py --row '<json>'   # internal: one point
 """
 
 from __future__ import annotations
@@ -15,25 +22,32 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import subprocess
 import sys
 import time
 
 
-def run_row(bench_mod, flash_mod, *, batch, seq_len, remat, attn_impl,
-            block_q=None, block_kv=None, steps=10, warmup=4):
+def run_row_inprocess(bench_mod, flash_mod, *, batch, seq_len, remat,
+                      attn_impl, block_q=None, block_kv=None, impl=None,
+                      steps=10, warmup=4):
     """One sweep point; returns the bench row dict or an error record."""
     label = {"per_chip_batch": batch, "seq_len": seq_len, "remat": remat,
              "attn_impl": attn_impl,
              "block_q": block_q or flash_mod.DEFAULT_BLOCK_Q,
-             "block_kv": block_kv or flash_mod.DEFAULT_BLOCK_KV}
+             "block_kv": block_kv or flash_mod.DEFAULT_BLOCK_KV,
+             "impl": impl or "auto"}
     orig = flash_mod.flash_attention
     try:
-        if block_q or block_kv:
+        if block_q or block_kv or impl:
             # attention() calls flash_attention() with default blocks; pin
             # the sweep's blocks without plumbing a new argument everywhere.
+            # Block sizes only reach the ONLINE kernels — "auto" dispatches
+            # these shapes to the one-shot kernel, which ignores them — so
+            # block rows must pin impl="online" to measure anything.
             wrapped = functools.partial(
                 orig, block_q=block_q or flash_mod.DEFAULT_BLOCK_Q,
-                block_kv=block_kv or flash_mod.DEFAULT_BLOCK_KV)
+                block_kv=block_kv or flash_mod.DEFAULT_BLOCK_KV,
+                impl=impl or "auto")
             flash_mod.flash_attention = wrapped
         t0 = time.perf_counter()
         row = bench_mod.bench("gpt2", per_chip_batch=batch, steps=steps,
@@ -50,6 +64,28 @@ def run_row(bench_mod, flash_mod, *, batch, seq_len, remat, attn_impl,
                             or "Out of memory" in msg else msg[:200]))
     finally:
         flash_mod.flash_attention = orig
+    return label
+
+
+def run_row(**point):
+    """Run one sweep point in a fresh subprocess (isolated allocator)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--row", json.dumps(point)],
+            capture_output=True, text=True, timeout=900, cwd=".",
+        )
+    except subprocess.TimeoutExpired:
+        # A wedged row (hung compile helper) is data too; keep sweeping.
+        label = dict(point, ok=False, error="subprocess timeout (900s)")
+        print(json.dumps(label), file=sys.stderr, flush=True)
+        return label
+    out = proc.stdout.strip().splitlines()
+    try:
+        label = json.loads(out[-1])
+    except (IndexError, json.JSONDecodeError):
+        label = dict(point, ok=False,
+                     error=f"subprocess rc={proc.returncode}: "
+                           f"{proc.stderr.strip()[-200:]}")
     print(json.dumps(label), file=sys.stderr, flush=True)
     return label
 
@@ -59,36 +95,46 @@ def main(argv=None):
     p.add_argument("--out", default="LM_SWEEP.json")
     p.add_argument("--quick", action="store_true",
                    help="batch/remat grid only (skip block + S=2048 axes)")
+    p.add_argument("--row", default=None,
+                   help="internal: run one json-encoded point in-process")
     args = p.parse_args(argv)
 
-    import jax
+    if args.row:
+        import bench as bench_mod
+        from pytorch_distributed_training_example_tpu.ops import (
+            flash_attention as flash_mod)
 
-    import bench as bench_mod
-    from pytorch_distributed_training_example_tpu.ops import (
-        flash_attention as flash_mod)
+        label = run_row_inprocess(bench_mod, flash_mod,
+                                  **json.loads(args.row))
+        print(json.dumps(label))
+        return 0
+
+    import jax
 
     rows = []
     # Axis 1: per-chip batch x remat at S=1024, flash attention.
     for batch in (8, 16, 32, 64):
         for remat in (False, True):
-            rows.append(run_row(bench_mod, flash_mod, batch=batch,
-                                seq_len=1024, remat=remat, attn_impl="flash"))
+            rows.append(run_row(batch=batch, seq_len=1024, remat=remat,
+                                attn_impl="flash"))
     # Axis 2: XLA attention at the best-looking batches (flash vs XLA).
     for batch in (16, 32):
-        rows.append(run_row(bench_mod, flash_mod, batch=batch, seq_len=1024,
-                            remat=False, attn_impl="xla"))
+        rows.append(run_row(batch=batch, seq_len=1024, remat=False,
+                            attn_impl="xla"))
     if not args.quick:
-        # Axis 3: flash block sizes at the best batch (S=1024 -> blocks
-        # divide 1024; 512 is the default).
-        for bq, bkv in ((256, 256), (256, 512), (512, 256), (1024, 512),
-                        (512, 1024), (1024, 1024)):
-            rows.append(run_row(bench_mod, flash_mod, batch=32, seq_len=1024,
-                                remat=False, attn_impl="flash",
-                                block_q=bq, block_kv=bkv))
+        # Axis 3: ONLINE-kernel block sizes at the best batch (the one-shot
+        # kernel self-plans its tiling, so blocks only exist on the online
+        # path), plus one forced-online row at default blocks as the
+        # oneshot-vs-online e2e comparison.
+        for bq, bkv in ((512, 512), (256, 512), (1024, 512), (512, 1024),
+                        (1024, 1024)):
+            rows.append(run_row(batch=16, seq_len=1024, remat=False,
+                                attn_impl="flash", block_q=bq, block_kv=bkv,
+                                impl="online"))
         # Axis 4: S=2048 (longer sequence shifts attention share upward).
         for batch in (4, 8, 16):
-            rows.append(run_row(bench_mod, flash_mod, batch=batch,
-                                seq_len=2048, remat=False, attn_impl="flash"))
+            rows.append(run_row(batch=batch, seq_len=2048, remat=False,
+                                attn_impl="flash"))
 
     ok_rows = [r for r in rows if r.get("ok")]
     best = max(ok_rows, key=lambda r: r["mfu"]) if ok_rows else None

@@ -302,38 +302,241 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
     return tr(dq), tr(dk), tr(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+# ---------------------------------------------------------------------------
+# One-shot kernels: short/medium sequences (the LM bench shapes).
+#
+# The online-softmax kernels above are grid-step bound at small head_dim:
+# measured on v5e at B=16,H=12,S=1024,D=64, the (B,H,q,kv) grid runs ~8 us
+# per step regardless of causality or FLOPs (6.2 ms fwd ~ 2% of MXU peak;
+# XLA's attention and jax.experimental's reference Pallas kernel land in the
+# same 6-9 ms band — see BENCH_FLASH_MICRO.json). When the whole KV fits in
+# VMEM there is no reason to stream it: these kernels give each program a
+# full [block_q, Skv] score tile and do plain fp32 softmax in registers —
+# no scratch state, no revisiting, no per-kv-step DMA boundaries — and
+# optionally batch G heads per program to amortize DMA latency. Backward
+# computes dq/dk/dv in ONE pass (dk/dv accumulated across q blocks in VMEM).
+# ---------------------------------------------------------------------------
+
+ONESHOT_BUDGET = 10 * 1024 * 1024  # ~16 MB VMEM/core minus operand buffers
+
+
+def _oneshot_plan(H, Sq, Skv, D, *, bwd=False):
+    """Pick (heads_per_program G, q_rows_per_program bq), or None.
+
+    Cost model (bytes live per program): fwd keeps s/p f32 + p bf16 tiles
+    (~10 B per (g, q, kv) cell) + k/v blocks; bwd adds dp/ds tiles and the
+    f32 dk/dv accumulators. None -> KV too long for a dense score tile;
+    caller falls back to the online-softmax kernels.
+    """
+    cell = 14 if bwd else 10
+    kvbytes = (16 if bwd else 4) * Skv * D
+    best = None
+    for g in range(min(H, 8), 0, -1):
+        if H % g:
+            continue
+        for bq in (1024, 512, 256, 128, 64, 32, 16):
+            if bq > Sq or Sq % bq:
+                continue
+            if cell * g * bq * Skv + g * kvbytes <= ONESHOT_BUDGET:
+                key = (g * bq, bq)  # maximize work per program, then fat bq
+                if best is None or key > best[0]:
+                    best = (key, (g, bq))
+                break  # smaller bq only shrinks work per program
+    return best[1] if best else None
+
+
+def _causal_mask(s, qi, block_q):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                        sm_scale, causal, block_q):
+    qi = pl.program_id(2)
+    q = _mxu(q_ref[0])                            # [G, bq, D]
+    k = _mxu(k_ref[0])                            # [G, Skv, D]
+    v = _mxu(v_ref[0])
+    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        s = _causal_mask(s, qi, block_q)
+    m = jnp.max(s, axis=2, keepdims=True)         # [G, bq, 1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=2, keepdims=True)
+    o = jax.lax.dot_general(p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[0] = jnp.broadcast_to(lse, (*lse.shape[:2], LSE_LANES))
+
+
+def _oneshot_fwd(q, k, v, *, causal, plan):
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    G, bq = plan
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    grid = (B, H // G, Sq // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_oneshot_fwd_kernel, sm_scale=1.0 / math.sqrt(D),
+                          causal=causal, block_q=bq),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, G, Skv, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, G, Skv, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, G, bq, LSE_LANES), lambda b, h, i: (b, h, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _oneshot_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                        sm_scale, causal, block_q):
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = _mxu(q_ref[0])                            # [G, bq, D]
+    k = _mxu(k_ref[0])                            # [G, Skv, D]
+    v = _mxu(v_ref[0])
+    do = _mxu(do_ref[0])
+    lse = lse_ref[0][..., :1]                     # [G, bq, 1]
+    delta = delta_ref[0][..., :1]
+    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        s = _causal_mask(s, qi, block_q)
+    p = jnp.exp(s - lse)                          # [G, bq, Skv]
+    dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+    dq = jax.lax.dot_general(ds, k, (((2,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                     (((1,), (1,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+    dk_acc[:] += jax.lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan):
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    G, bq = plan
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LSE_LANES))
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+    qspec = pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0))
+    kspec = pl.BlockSpec((1, G, Skv, D), lambda b, h, i: (b, h, 0, 0))
+    lspec = pl.BlockSpec((1, G, bq, LSE_LANES), lambda b, h, i: (b, h, i, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_oneshot_bwd_kernel, sm_scale=1.0 / math.sqrt(D),
+                          causal=causal, block_q=bq),
+        grid=(B, H // G, Sq // bq),
+        in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
+        out_specs=(qspec, kspec, kspec),
+        out_shape=(jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((G, Skv, D), jnp.float32),
+                        pltpu.VMEM((G, Skv, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt, dot, lse, delta)
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_kv: int = DEFAULT_BLOCK_KV):
+                    block_kv: int = DEFAULT_BLOCK_KV,
+                    impl: str = "auto"):
     """Flash attention with the XLA oracle's exact semantics.
 
     [B, S, H, D] layout; fp32 softmax; GQA via fewer KV heads. Forward and
-    backward are both Pallas kernels (FlashAttention-2 recomputation scheme:
-    residuals are q/k/v/o + per-row logsumexp, never the S x S matrix).
+    backward are both Pallas kernels. ``impl``: "auto" picks the one-shot
+    dense-score kernels when KV fits VMEM (short/medium S — see
+    ``_oneshot_plan``) and the online-softmax streaming kernels otherwise
+    (FlashAttention-2 recomputation scheme: residuals are q/k/v/o + per-row
+    logsumexp, never the S x S matrix in HBM); "oneshot"/"online" force.
     """
     k = attn_lib._repeat_kv(k, q.shape[2])
     v = attn_lib._repeat_kv(v, q.shape[2])
-    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                        block_kv=block_kv)
+    out, _ = _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_kv):
+def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl):
+    B, Sq, H, D = q.shape
+    plan = None
+    if impl in ("auto", "oneshot"):
+        plan = _oneshot_plan(H, Sq, k.shape[1], D)
+    if impl == "oneshot" and plan is None:
+        raise ValueError(f"oneshot flash attention cannot tile "
+                         f"Sq={Sq}, Skv={k.shape[1]}, D={D} within VMEM")
+    if plan is not None:
+        return _oneshot_fwd(q, k, v, causal=causal, plan=plan)
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                      block_kv=block_kv)
+
+
+def _vjp_fwd(q, k, v, causal, block_q, block_kv, impl):
     ke = attn_lib._repeat_kv(k, q.shape[2])
     ve = attn_lib._repeat_kv(v, q.shape[2])
-    out, lse = _flash_fwd(q, ke, ve, causal=causal, block_q=block_q,
-                          block_kv=block_kv)
+    out, lse = _fwd_dispatch(q, ke, ve, causal, block_q, block_kv, impl)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, block_q, block_kv, res, g):
+def _vjp_bwd(causal, block_q, block_kv, impl, res, g):
     q, k, v, o, lse = res
     H, Hkv = q.shape[2], k.shape[2]
     ke = attn_lib._repeat_kv(k, H)
     ve = attn_lib._repeat_kv(v, H)
-    dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
-                            block_q=block_q, block_kv=block_kv)
+    plan = None
+    if impl in ("auto", "oneshot"):
+        plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True)
+    if impl == "oneshot" and plan is None:
+        raise ValueError(
+            f"oneshot flash attention backward cannot tile Sq={q.shape[1]}, "
+            f"Skv={ke.shape[1]}, D={q.shape[3]} within VMEM (the backward "
+            f"needs ~40% more live bytes than the forward); use impl='auto' "
+            f"to fall back to the online kernels for such shapes")
+    if plan is not None:
+        dq, dk, dv = _oneshot_bwd(q, ke, ve, o, lse, g, causal=causal,
+                                  plan=plan)
+    else:
+        dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
+                                block_q=block_q, block_kv=block_kv)
     if Hkv != H:
         # GQA: fold the repeated-head grads back onto the shared KV heads.
         B, S, _, D = dk.shape

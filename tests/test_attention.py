@@ -97,23 +97,26 @@ def test_ulysses_head_divisibility_error(devices):
         A.ulysses_attention(q, k, v, mesh=mesh)
 
 
+@pytest.mark.parametrize("impl", ["oneshot", "online"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_kernel_interpret(causal):
+def test_flash_kernel_interpret(causal, impl):
     q, k, v = _qkv(S=128)
     ref = A.dot_product_attention(q, k, v, causal=causal)
     with pltpu.force_tpu_interpret_mode():
-        out = F.flash_attention(q, k, v, causal, 32, 32)
+        out = F.flash_attention(q, k, v, causal, 32, 32, impl)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_flash_grads_interpret():
+@pytest.mark.parametrize("impl", ["oneshot", "online"])
+def test_flash_grads_interpret(impl):
     q, k, v = _qkv(S=64)
     g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
                      argnums=(0, 1, 2))(q, k, v)
     with pltpu.force_tpu_interpret_mode():
-        g_out = jax.grad(lambda *a: F.flash_attention(*a, True, 32, 32).sum(),
-                         argnums=(0, 1, 2))(q, k, v)
+        g_out = jax.grad(
+            lambda *a: F.flash_attention(*a, True, 32, 32, impl).sum(),
+            argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
@@ -139,15 +142,17 @@ def test_ring_and_ulysses_with_tp_heads(devices):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_flash_gqa_grads_interpret():
+@pytest.mark.parametrize("impl", ["oneshot", "online"])
+def test_flash_gqa_grads_interpret(impl):
     from jax.experimental.pallas import tpu as pltpu
 
     q, k, v = _qkv(S=64, H=4, Hkv=2)
     g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
                      argnums=(0, 1, 2))(q, k, v)
     with pltpu.force_tpu_interpret_mode():
-        g_out = jax.grad(lambda *a: F.flash_attention(*a, True, 32, 32).sum(),
-                         argnums=(0, 1, 2))(q, k, v)
+        g_out = jax.grad(
+            lambda *a: F.flash_attention(*a, True, 32, 32, impl).sum(),
+            argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
