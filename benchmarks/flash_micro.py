@@ -5,9 +5,11 @@ Prints per-config: measured ms, attention-FLOPs, achieved TF/s and
 fraction-of-peak, flash kernel vs XLA dot-product attention. Informs the
 GPT-2 MFU ceiling analysis (LM_SWEEP.json).
 
-Timing idiom matches bench.py: N dependent iterations inside one
-``lax.scan`` under jit, synced by a host transfer of the carried scalar —
-``block_until_ready`` alone does not synchronize through the axon tunnel.
+Timing is SLOPE-BASED: chained iterations inside one ``lax.scan`` under
+jit, synced by a host transfer (``block_until_ready`` alone does not
+synchronize through the axon tunnel), measured at two trip counts; the
+per-iteration time is the slope, which cancels the ~75 ms fixed dispatch
+cost the tunnel adds per executable call.
 """
 
 from __future__ import annotations
@@ -48,22 +50,26 @@ def main():
         return attn_lib.dot_product_attention(q, k, v, causal=causal)
 
     def timed(fn_one, q, k, v):
-        """ms per iteration of q <- fn_one(q, k, v), scanned."""
-        def body(qq, _):
-            return fn_one(qq, k, v), ()
+        """ms per iteration of q <- fn_one(q, k, v): two-length slope."""
+        def at_length(L):
+            def body(qq, _):
+                return fn_one(qq, k, v), ()
 
-        @jax.jit
-        def run(q):
-            out, _ = jax.lax.scan(body, q, None, length=args.iters)
-            return jnp.float32(out[0, 0, 0, 0])
+            @jax.jit
+            def run(q):
+                out, _ = jax.lax.scan(body, q, None, length=L)
+                return jnp.float32(out[0, 0, 0, 0])
 
-        np.asarray(run(q))  # compile + warm
-        dt = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(run(q))
-            dt = min(dt, time.perf_counter() - t0)
-        return dt / args.iters * 1e3
+            np.asarray(run(q))  # compile + warm
+            dt = float("inf")
+            for _ in range(4):
+                t0 = time.perf_counter()
+                np.asarray(run(q))
+                dt = min(dt, time.perf_counter() - t0)
+            return dt
+
+        L1, L2 = args.iters, 4 * args.iters
+        return max(at_length(L2) - at_length(L1), 1e-9) / (L2 - L1) * 1e3
 
     rows = []
     for (B, H, S, D) in ((16, 12, 1024, 64), (4, 12, 2048, 64),
@@ -73,16 +79,26 @@ def main():
         k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
         v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
 
-        for name, fn in (
-                ("flash", functools.partial(fa.flash_attention, causal=True)),
-                ("xla", xla_attn)):
+        def oneshot(q, k, v):
+            return fa.flash_attention(q, k, v, True, fa.DEFAULT_BLOCK_Q,
+                                      fa.DEFAULT_BLOCK_KV, "oneshot")
+
+        def online(q, k, v):
+            return fa.flash_attention(q, k, v, True, fa.DEFAULT_BLOCK_Q,
+                                      fa.DEFAULT_BLOCK_KV, "online")
+
+        for name, fn in (("oneshot", oneshot), ("online", online),
+                         ("xla", xla_attn)):
             ms_f = timed(fn, q, k, v)
 
             def grad_step(qq, k, v, fn=fn):
-                g = jax.grad(
-                    lambda q3: jnp.sum(fn(q3, k, v).astype(jnp.float32)
-                                       ) * 1e-3)(qq)
-                return g.astype(qq.dtype)
+                # All three grads consumed: taking only dq lets XLA DCE the
+                # online path's separate dk/dv kernel and understates bwd.
+                dq, dk, dv = jax.grad(
+                    lambda q3, k3, v3: jnp.sum(
+                        fn(q3, k3, v3).astype(jnp.float32)) * 1e-3,
+                    argnums=(0, 1, 2))(qq, k, v)
+                return (dq + dk + dv).astype(qq.dtype)
 
             ms_b = timed(grad_step, q, k, v)
 

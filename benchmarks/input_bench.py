@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput artifact (VERDICT r2 #5; SURVEY.md §7(a)).
+
+Measures the loader alone (JPEG decode + augment + collate, no device) at a
+worker-count sweep, for both the native C++ engine and the Python/PIL path,
+then answers the feed-rate question: how many host cores does it take to
+feed the measured ResNet-50 device rate (golden.json)?
+
+This CI host has very few cores (os.cpu_count() is recorded in the
+artifact); the per-core rate is computed at the worker count that maximizes
+throughput, and the cores-needed figure extrapolates linearly — the loader
+is embarrassingly parallel across images (per-sample RNG is keyed on
+dataset index, so parallelism does not change results).
+
+    python benchmarks/input_bench.py [--out BENCH_INPUT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_INPUT.json")
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--workers", default="1,2,4,8")
+    args = p.parse_args(argv)
+
+    from bench import bench_input
+
+    import json as _json
+
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "golden.json")
+    with open(golden_path) as f:
+        golden = _json.load(f)
+    device_rate = (golden.get("TPU v5 lite", {})
+                   .get("resnet50_imagenet_train_throughput", {})
+                   .get("value"))
+
+    rows = []
+    for native in (True, False):
+        for w in [int(x) for x in args.workers.split(",")]:
+            try:
+                r = bench_input(args.data_path, batch_size=args.batch_size,
+                                batches=args.batches, workers=w,
+                                native=native)
+                rows.append({"workers": w, "native_requested": native, **r})
+            except Exception as e:
+                rows.append({"workers": w, "native_requested": native,
+                             "ok": False, "error": str(e)[:200]})
+            print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
+    ok = [r for r in rows if r.get("input_images_per_sec")]
+    best = max(ok, key=lambda r: r["input_images_per_sec"]) if ok else None
+    cores = os.cpu_count() or 1
+    summary = {}
+    if best and device_rate:
+        per_core = best["input_images_per_sec"] / cores
+        summary = {
+            "best_images_per_sec": best["input_images_per_sec"],
+            "host_cpus": cores,
+            "images_per_sec_per_core": round(per_core, 1),
+            "device_rate_images_per_sec_per_chip": device_rate,
+            "cores_to_feed_one_chip": round(device_rate / per_core, 1),
+        }
+    out = {
+        "bench": "input_pipeline",
+        "note": "loader-only host throughput; device untouched. Extrapolated "
+                "linearly from this host's core count (decode is "
+                "embarrassingly parallel across images).",
+        "rows": rows,
+        "summary": summary,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"summary": summary, "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

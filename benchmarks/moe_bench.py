@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""MoE dispatch microbenchmark: gather vs einsum at real token counts
+(VERDICT r2 #8).
+
+Times one MoE block — router + dispatch + stacked-expert FFN + combine —
+fwd+bwd at GPT-2-scale dims (d=768, ffn=3072, E=8, top-2) across token
+counts, for both dispatch implementations (``parallel/moe.py``). The
+einsum path's O(T*E*C) dispatch mask is the thing being measured against
+the gather path's O(E*C*d + T*k) slot table.
+
+Slope-timed (two scan trip counts — cancels the ~75 ms fixed dispatch
+cost of the tunnel; see BENCH_FLASH_MICRO.json).
+
+    python benchmarks/moe_bench.py [--out BENCH_MOE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+D_MODEL = 768
+FFN = 3072
+EXPERTS = 8
+
+
+def bench_point(T, impl):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
+
+    block = MoEBlock(EXPERTS, FFN, dispatch_impl=impl, dtype=jnp.bfloat16,
+                     param_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, T, D_MODEL),
+                          jnp.bfloat16)
+    variables = block.init({"params": jax.random.PRNGKey(1)}, x, train=False)
+    params = variables["params"]
+
+    def loss_fn(params, x):
+        out, _ = block.apply({"params": params}, x, train=False,
+                             mutable=["losses"])
+        return jnp.sum(out.astype(jnp.float32)) * 1e-3
+
+    grad_fn = jax.grad(loss_fn, argnums=(0, 1))
+
+    def at_length(L):
+        def body(carry, _):
+            gp, gx = grad_fn(params, x + carry.astype(x.dtype))
+            s = sum(jnp.sum(g.astype(jnp.float32))
+                    for g in jax.tree.leaves(gp))
+            return (s * 1e-30 + jnp.float32(jnp.sum(
+                gx.astype(jnp.float32)) * 1e-30)).astype(jnp.float32), ()
+
+        @jax.jit
+        def run(c0):
+            c, _ = jax.lax.scan(body, c0, None, length=L)
+            return c
+
+        np.asarray(run(jnp.float32(0)))
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run(jnp.float32(0)))
+            dt = min(dt, time.perf_counter() - t0)
+        return dt
+
+    L1, L2 = 10, 40
+    sec = max(at_length(L2) - at_length(L1), 1e-9) / (L2 - L1)
+    # per-token expert FLOPs: top-2 x (3 matmuls of d*ffn) x 2 MAC x fwd+2bwd
+    flops = T * 2 * 3 * D_MODEL * FFN * 2 * 3
+    return {"tokens": T, "dispatch": impl, "ms": round(sec * 1e3, 3),
+            "tokens_per_sec": round(T / sec),
+            "expert_tflops": round(flops / sec / 1e12, 1)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_MOE.json")
+    p.add_argument("--tokens", default="4096,16384,65536")
+    args = p.parse_args(argv)
+    import jax
+
+    rows = []
+    for T in [int(x) for x in args.tokens.split(",")]:
+        for impl in ("gather", "einsum"):
+            try:
+                rows.append(bench_point(T, impl))
+            except Exception as e:
+                msg = str(e)
+                rows.append({"tokens": T, "dispatch": impl, "ok": False,
+                             "error": ("OOM" if "RESOURCE_EXHAUSTED" in msg
+                                       else msg[:200])})
+            print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    out = {
+        "bench": "moe_dispatch_gather_vs_einsum",
+        "device": jax.devices()[0].device_kind,
+        "dims": {"d_model": D_MODEL, "ffn": FFN, "experts": EXPERTS,
+                 "top_k": 2, "capacity_factor": 1.25},
+        "pass": "fwd+bwd (params and input grads)",
+        "timing": "two-trip-count slope, chained scan, best of 3 per point",
+        "rows": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"rows": rows, "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,8 +30,11 @@ from jax.experimental.pallas import tpu as pltpu
 from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
 
 NEG_INF = -1e30
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
+# Online-kernel defaults (the one-shot kernels self-plan their tiling):
+# 1024x1024 measured best e2e of the {256,512,1024}^2 grid — GPT-2 S=1024
+# forced-online MFU 0.5475 vs 0.4888 at the old 512x512 (LM_SWEEP.json).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_KV = 1024
 LSE_LANES = 8  # lse stored [B,H,S,8]: minor dims satisfy Mosaic tiling
 
 
@@ -320,7 +323,7 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
 ONESHOT_BUDGET = 10 * 1024 * 1024  # ~16 MB VMEM/core minus operand buffers
 
 
-def _oneshot_plan(H, Sq, Skv, D, *, bwd=False):
+def _oneshot_plan(H, Sq, Skv, D, *, bwd=False, forced=False):
     """Pick (heads_per_program G, q_rows_per_program bq), or None.
 
     Cost model (bytes live per program): fwd keeps s/p f32 + p bf16 tiles
@@ -330,12 +333,20 @@ def _oneshot_plan(H, Sq, Skv, D, *, bwd=False):
     """
     cell = 14 if bwd else 10
     kvbytes = (16 if bwd else 4) * Skv * D
+    # Under "auto", plans whose q tile is thinner than 256 rows are
+    # rejected — they lose to the online kernels: measured at S=4096/D=128
+    # the degenerate bq=16/128 one-shot plans run 2x slower than
+    # online@1024-blocks (BENCH_FLASH_MICRO.json), while every bq>=256 plan
+    # measured wins. Tiny sequences (Sq<256) are exempt — there the whole
+    # problem fits one program. impl="oneshot" (forced) skips the
+    # threshold so the kernel stays measurable at any feasible shape.
+    min_bq = 1 if forced else min(256, Sq)
     best = None
     for g in range(min(H, 8), 0, -1):
         if H % g:
             continue
         for bq in (1024, 512, 256, 128, 64, 32, 16):
-            if bq > Sq or Sq % bq:
+            if bq > Sq or Sq % bq or bq < min_bq:
                 continue
             if cell * g * bq * Skv + g * kvbytes <= ONESHOT_BUDGET:
                 key = (g * bq, bq)  # maximize work per program, then fat bq
@@ -500,7 +511,7 @@ def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl):
     B, Sq, H, D = q.shape
     plan = None
     if impl in ("auto", "oneshot"):
-        plan = _oneshot_plan(H, Sq, k.shape[1], D)
+        plan = _oneshot_plan(H, Sq, k.shape[1], D, forced=impl == "oneshot")
     if impl == "oneshot" and plan is None:
         raise ValueError(f"oneshot flash attention cannot tile "
                          f"Sq={Sq}, Skv={k.shape[1]}, D={D} within VMEM")
@@ -524,7 +535,8 @@ def _vjp_bwd(causal, block_q, block_kv, impl, res, g):
     ve = attn_lib._repeat_kv(v, H)
     plan = None
     if impl in ("auto", "oneshot"):
-        plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True)
+        plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True,
+                             forced=impl == "oneshot")
     if impl == "oneshot" and plan is None:
         raise ValueError(
             f"oneshot flash attention backward cannot tile Sq={q.shape[1]}, "
