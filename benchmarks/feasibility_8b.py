@@ -143,4 +143,9 @@ if __name__ == "__main__":
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                f" --xla_force_host_platform_device_count={MAX_DEVICES}").strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # The image's sitecustomize pins the axon TPU platform before env vars
+    # are read; re-assert CPU through the config API (see launch docs).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     raise SystemExit(main())

@@ -31,7 +31,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ITERS_SHORT = 20
-ITERS_LONG = 80
+ITERS_LONG = 120
 
 # [B*H*W, Cin, Cout] instances of the bottleneck 1x1 convs at batch 128
 # (stage2 reduce/expand, stage3 reduce), PROFILE_RN50.md's canonical shapes.
@@ -59,11 +59,32 @@ def _timed_at(fn, *args):
     return best
 
 
-def _timed(make_loop, *args):
-    """Per-iteration seconds via the two-trip-count slope."""
-    t_short = _timed_at(make_loop(ITERS_SHORT), *args)
-    t_long = _timed_at(make_loop(ITERS_LONG), *args)
-    return max(t_long - t_short, 1e-9) / (ITERS_LONG - ITERS_SHORT)
+def _timed_pair(make_un, make_fu, *args, reps=3):
+    """Interleaved A/B slope timing: [unfused, fused] per-iter seconds.
+
+    Tunnel load drifts on the scale of a single measurement, so the two
+    arms are measured back-to-back in each repetition (A,B,A,B,...) and the
+    per-arm slope uses the min over repetitions at each trip count —
+    uncorrelated drift then inflates both arms equally instead of flipping
+    the ratio between runs.
+    """
+    compiled = {}
+    for tag, mk in (("un", make_un), ("fu", make_fu)):
+        for L in (ITERS_SHORT, ITERS_LONG):
+            compiled[tag, L] = mk(L)
+    best = {k: float("inf") for k in compiled}
+    times = {("un", ITERS_SHORT): [], ("un", ITERS_LONG): [],
+             ("fu", ITERS_SHORT): [], ("fu", ITERS_LONG): []}
+    for _ in range(reps):
+        for key, fn in compiled.items():
+            t = _timed_at(fn, *args)
+            best[key] = min(best[key], t)
+            times[key].append(round(t * 1e3, 1))
+    out = []
+    for tag in ("un", "fu"):
+        slope = max(best[tag, ITERS_LONG] - best[tag, ITERS_SHORT], 1e-9)
+        out.append(slope / (ITERS_LONG - ITERS_SHORT))
+    return out[0], out[1], {k[0] + str(k[1]): v for k, v in times.items()}
 
 
 def bench_shape(N, K, C, dtype_name="bfloat16"):
@@ -110,8 +131,8 @@ def bench_shape(N, K, C, dtype_name="bfloat16"):
 
         return make
 
-    t_un = _timed(loop(unfused_once), jnp.float32(0))
-    t_fu = _timed(loop(fused_once), jnp.float32(0))
+    t_un, t_fu, raw = _timed_pair(loop(unfused_once), loop(fused_once),
+                                  jnp.float32(0))
 
     bpe = jnp.finfo(dtype).bits // 8
     # Logical HBM traffic per iteration (reads of x + write/read of y):
@@ -122,6 +143,7 @@ def bench_shape(N, K, C, dtype_name="bfloat16"):
         "unfused_ms": round(t_un * 1e3, 3),
         "fused_ms": round(t_fu * 1e3, 3),
         "speedup": round(t_un / t_fu, 3),
+        "raw_wall_ms": raw,
         "unfused_logical_gb": round(unfused_bytes / 1e9, 3),
         "fused_logical_gb": round(fused_bytes / 1e9, 3),
         "unfused_gbps": round(unfused_bytes / t_un / 1e9, 1),
