@@ -377,8 +377,12 @@ def attention(
     if impl == "auto":
         if ctx > 1:
             impl = "ring_zigzag" if causal else "ring"
+        elif _flash_eligible(q, k):
+            impl = "flash"
+        elif _padded_flash_eligible(q, k, explicit=False):
+            return padded_flash_attention(q, k, v, causal=causal)
         else:
-            impl = "flash" if _flash_eligible(q, k) else "xla"
+            impl = "xla"
     elif impl in ("ring", "ring_zigzag", "ulysses") and ctx == 1:
         # No context axis to parallelize over (includes init-time tracing
         # outside use_mesh): all collapse to plain attention.
@@ -397,18 +401,78 @@ def attention(
                                  causal=causal, batch_axes=batch_axes)
     if impl == "flash":
         if not _flash_eligible(q, k, explicit=True):
+            if _padded_flash_eligible(q, k):
+                return padded_flash_attention(q, k, v, causal=causal)
             import logging
 
             logging.getLogger(__name__).warning(
                 "attn_impl='flash' not eligible for shape q=%s k=%s on %s "
-                "(needs seq %% 512 == 0, head_dim in {64,128,256}, TPU); "
-                "falling back to XLA attention",
-                q.shape, k.shape, jax.default_backend())
+                "(needs seq %% 512 == 0 or a VMEM-fitting padded one-shot "
+                "plan, head_dim in {64,128,256}, TPU); falling back to XLA "
+                "attention", q.shape, k.shape, jax.default_backend())
             return dot_product_attention(q, k, v, causal=causal)
         from pytorch_distributed_training_example_tpu.ops import flash_attention
 
         return flash_attention.flash_attention(q, k, v, causal=causal)
     return dot_product_attention(q, k, v, causal=causal)
+
+
+PAD_MULTIPLE = 64  # tile granularity shared by pad + eligibility below
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def padded_flash_attention(q, k, v, *, causal=False,
+                           multiple: int = PAD_MULTIPLE):
+    """Flash attention for non-tile-aligned S via padding + key masking.
+
+    ViT-B/16's 197 tokens (and any sequence the block kernels can't tile)
+    are zero-padded up to the next ``multiple``; the one-shot kernel masks
+    padded keys with ``kv_len`` so softmax never attends to them, and the
+    padded query rows are sliced away (their cotangents are zero, so the
+    extra rows contribute nothing to gradients). Pays (Sp/S)^2 extra
+    attention FLOPs — at ViT's 197->256 that is +69% on a term that is
+    ~4% of model FLOPs, far cheaper than XLA attention's unfused softmax
+    passes at these shapes (BENCH_FLASH_MICRO.json: one-shot 2.8x XLA).
+    """
+    from pytorch_distributed_training_example_tpu.ops import flash_attention
+
+    S = q.shape[1]
+    if k.shape[1] != S:
+        raise ValueError(
+            f"padded_flash_attention needs Sq == Skv (kv_len masking is "
+            f"derived from q's length); got Sq={S}, Skv={k.shape[1]}")
+    Sp = _round_up(S, multiple)
+    if Sp != S:
+        pad = ((0, 0), (0, Sp - S), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    out = flash_attention.flash_attention(
+        q, k, v, causal, flash_attention.DEFAULT_BLOCK_Q,
+        flash_attention.DEFAULT_BLOCK_KV, "auto", S if Sp != S else None)
+    return out[:, :S] if Sp != S else out
+
+
+def _padded_flash_eligible(q, k, multiple: int = PAD_MULTIPLE,
+                           explicit: bool = True) -> bool:
+    from pytorch_distributed_training_example_tpu.ops import flash_attention
+
+    if jax.default_backend() in ("cpu",) or q.shape[-1] not in (64, 128, 256):
+        return False
+    if q.shape[1] != k.shape[1]:  # cross-shard ring chunks: keep simple
+        return False
+    Sp = _round_up(q.shape[1], multiple)
+    if not explicit and Sp < 1024:
+        # Same threshold as _flash_eligible's auto mode, re-validated for
+        # the padded path: ViT-B/16 (197->256) measured 690 img/s padded
+        # one-shot vs 730 img/s XLA — below ~1024 tokens XLA's fused
+        # attention wins and padding FLOPs only add to that.
+        return False
+    H, D = q.shape[2], q.shape[3]
+    return (flash_attention._oneshot_plan(H, Sp, Sp, D) is not None
+            and flash_attention._oneshot_plan(H, Sp, Sp, D, bwd=True)
+            is not None)
 
 
 def _flash_eligible(q, k, explicit: bool = False) -> bool:

@@ -362,8 +362,14 @@ def _causal_mask(s, qi, block_q):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _kv_len_mask(s, kv_len):
+    """Mask keys at positions >= kv_len (padded keys; see ``kv_len`` docs)."""
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    return jnp.where(k_pos < kv_len, s, NEG_INF)
+
+
 def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                        sm_scale, causal, block_q):
+                        sm_scale, causal, block_q, kv_len):
     qi = pl.program_id(2)
     q = _mxu(q_ref[0])                            # [G, bq, D]
     k = _mxu(k_ref[0])                            # [G, Skv, D]
@@ -372,6 +378,8 @@ def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                             preferred_element_type=jnp.float32) * sm_scale
     if causal:
         s = _causal_mask(s, qi, block_q)
+    if kv_len is not None:
+        s = _kv_len_mask(s, kv_len)
     m = jnp.max(s, axis=2, keepdims=True)         # [G, bq, 1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=2, keepdims=True)
@@ -382,7 +390,7 @@ def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0] = jnp.broadcast_to(lse, (*lse.shape[:2], LSE_LANES))
 
 
-def _oneshot_fwd(q, k, v, *, causal, plan):
+def _oneshot_fwd(q, k, v, *, causal, plan, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     G, bq = plan
@@ -392,7 +400,7 @@ def _oneshot_fwd(q, k, v, *, causal, plan):
     grid = (B, H // G, Sq // bq)
     out, lse = pl.pallas_call(
         functools.partial(_oneshot_fwd_kernel, sm_scale=1.0 / math.sqrt(D),
-                          causal=causal, block_q=bq),
+                          causal=causal, block_q=bq, kv_len=kv_len),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0)),
@@ -416,7 +424,7 @@ def _oneshot_fwd(q, k, v, *, causal, plan):
 
 def _oneshot_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                        sm_scale, causal, block_q):
+                        sm_scale, causal, block_q, kv_len):
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
 
@@ -435,6 +443,8 @@ def _oneshot_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             preferred_element_type=jnp.float32) * sm_scale
     if causal:
         s = _causal_mask(s, qi, block_q)
+    if kv_len is not None:
+        s = _kv_len_mask(s, kv_len)
     p = jnp.exp(s - lse)                          # [G, bq, Skv]
     dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
@@ -454,7 +464,7 @@ def _oneshot_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan):
+def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     G, bq = plan
@@ -470,7 +480,7 @@ def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan):
     lspec = pl.BlockSpec((1, G, bq, LSE_LANES), lambda b, h, i: (b, h, i, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_oneshot_bwd_kernel, sm_scale=1.0 / math.sqrt(D),
-                          causal=causal, block_q=bq),
+                          causal=causal, block_q=bq, kv_len=kv_len),
         grid=(B, H // G, Sq // bq),
         in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
         out_specs=(qspec, kspec, kspec),
@@ -487,11 +497,12 @@ def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan):
     return tr(dq), tr(dk), tr(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_kv: int = DEFAULT_BLOCK_KV,
-                    impl: str = "auto"):
+                    impl: str = "auto",
+                    kv_len: int | None = None):
     """Flash attention with the XLA oracle's exact semantics.
 
     [B, S, H, D] layout; fp32 softmax; GQA via fewer KV heads. Forward and
@@ -500,35 +511,43 @@ def flash_attention(q, k, v, causal: bool = False,
     ``_oneshot_plan``) and the online-softmax streaming kernels otherwise
     (FlashAttention-2 recomputation scheme: residuals are q/k/v/o + per-row
     logsumexp, never the S x S matrix in HBM); "oneshot"/"online" force.
+
+    ``kv_len`` (static): mask keys at positions >= kv_len. Used by the
+    tile-padding path in :func:`attention.padded_flash_attention` that
+    serves non-tile-aligned sequences (e.g. ViT's 197 tokens padded to
+    256); one-shot kernels only.
     """
     k = attn_lib._repeat_kv(k, q.shape[2])
     v = attn_lib._repeat_kv(v, q.shape[2])
-    out, _ = _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl)
+    out, _ = _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len)
     return out
 
 
-def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl):
+def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len):
     B, Sq, H, D = q.shape
     plan = None
     if impl in ("auto", "oneshot"):
         plan = _oneshot_plan(H, Sq, k.shape[1], D, forced=impl == "oneshot")
-    if impl == "oneshot" and plan is None:
+    if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(f"oneshot flash attention cannot tile "
-                         f"Sq={Sq}, Skv={k.shape[1]}, D={D} within VMEM")
+                         f"Sq={Sq}, Skv={k.shape[1]}, D={D} within VMEM"
+                         + (" (kv_len masking requires the one-shot kernels)"
+                            if kv_len is not None else ""))
     if plan is not None:
-        return _oneshot_fwd(q, k, v, causal=causal, plan=plan)
+        return _oneshot_fwd(q, k, v, causal=causal, plan=plan, kv_len=kv_len)
     return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                       block_kv=block_kv)
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_kv, impl):
+def _vjp_fwd(q, k, v, causal, block_q, block_kv, impl, kv_len):
     ke = attn_lib._repeat_kv(k, q.shape[2])
     ve = attn_lib._repeat_kv(v, q.shape[2])
-    out, lse = _fwd_dispatch(q, ke, ve, causal, block_q, block_kv, impl)
+    out, lse = _fwd_dispatch(q, ke, ve, causal, block_q, block_kv, impl,
+                             kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, block_q, block_kv, impl, res, g):
+def _vjp_bwd(causal, block_q, block_kv, impl, kv_len, res, g):
     q, k, v, o, lse = res
     H, Hkv = q.shape[2], k.shape[2]
     ke = attn_lib._repeat_kv(k, H)
@@ -537,15 +556,17 @@ def _vjp_bwd(causal, block_q, block_kv, impl, res, g):
     if impl in ("auto", "oneshot"):
         plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True,
                              forced=impl == "oneshot")
-    if impl == "oneshot" and plan is None:
+    if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(
             f"oneshot flash attention backward cannot tile Sq={q.shape[1]}, "
             f"Skv={ke.shape[1]}, D={q.shape[3]} within VMEM (the backward "
-            f"needs ~40% more live bytes than the forward); use impl='auto' "
-            f"to fall back to the online kernels for such shapes")
+            f"needs ~40% more live bytes than the forward"
+            + ("; kv_len masking requires the one-shot kernels)"
+               if kv_len is not None else "); use impl='auto' to fall back "
+               "to the online kernels for such shapes"))
     if plan is not None:
         dq, dk, dv = _oneshot_bwd(q, ke, ve, o, lse, g, causal=causal,
-                                  plan=plan)
+                                  plan=plan, kv_len=kv_len)
     else:
         dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
                                 block_q=block_q, block_kv=block_kv)

@@ -156,3 +156,31 @@ def test_flash_gqa_grads_interpret(impl):
     for a, b in zip(g_ref, g_out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padded_flash_matches_oracle(causal):
+    """Non-tile-aligned S (ViT's 197-token shape, scaled down) through the
+    pad + kv_len-mask path must match the oracle exactly — padded keys are
+    masked out of the softmax, padded query rows are sliced away."""
+    q, k, v = _qkv(S=50)  # 50 % 64 != 0 -> pads to 64
+    ref = A.dot_product_attention(q, k, v, causal=causal)
+    with pltpu.force_tpu_interpret_mode():
+        out = A.padded_flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padded_flash_grads(causal):
+    q, k, v = _qkv(S=50, H=4, Hkv=2)  # GQA + padding together
+    g_ref = jax.grad(
+        lambda *a: A.dot_product_attention(*a, causal=causal).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(
+            lambda *a: A.padded_flash_attention(*a, causal=causal).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
