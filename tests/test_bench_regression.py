@@ -49,8 +49,12 @@ def test_unknown_device_never_fails():
 
 def test_cli_handles_driver_wrapper(tmp_path):
     """The driver's BENCH_r{N}.json wraps the line under 'parsed' and is
-    pretty-printed (multi-line)."""
-    wrapper = {"rc": 0, "parsed": _result()}
+    pretty-printed (multi-line). Values track the REAL golden file (the
+    subprocess loads it): the test is about wrapper parsing, not numbers."""
+    golden = cr.load_golden()["TPU v5 lite"]
+    wrapper = {"rc": 0, "parsed": _result(
+        resnet=golden["resnet50_imagenet_train_throughput"]["value"],
+        lm=golden["gpt2_lm1024_train_throughput"]["value"])}
     f = tmp_path / "bench.json"
     f.write_text(json.dumps(wrapper, indent=2))
     proc = subprocess.run(
