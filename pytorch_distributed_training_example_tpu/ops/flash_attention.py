@@ -318,6 +318,13 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
 # no scratch state, no revisiting, no per-kv-step DMA boundaries — and
 # optionally batch G heads per program to amortize DMA latency. Backward
 # computes dq/dk/dv in ONE pass (dk/dv accumulated across q blocks in VMEM).
+#
+# Tried and rejected (measured, same slope-timing as BENCH_FLASH_MICRO):
+# splitting causal work into a low-kv half + full-kv half (two kernel
+# variants, q_base mask offset) to skip the ~37% masked tile area — fwd
+# improved 6% but fwd+bwd REGRESSED 6% (2.76 vs 2.61 ms at GPT-2 shapes):
+# the dk/dv pad+add stitch, duplicate k/v reads, and extra launches cost
+# more than the skipped FLOPs. Dense causal tiles are the keeper here.
 # ---------------------------------------------------------------------------
 
 ONESHOT_BUDGET = 10 * 1024 * 1024  # ~16 MB VMEM/core minus operand buffers
