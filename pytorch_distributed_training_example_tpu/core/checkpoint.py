@@ -35,6 +35,7 @@ import os
 import re
 import shutil
 import threading
+import time
 from typing import Any
 
 import jax
@@ -80,6 +81,59 @@ class Checkpointer:
             # them and desynchronize --resume across hosts. __init__ runs on
             # the main thread (same thread as train-step collectives).
             distributed.barrier("ckpt_init_recover")
+            self._validate_shared_filesystem()
+
+    def _validate_shared_filesystem(self):
+        """Fail fast if the checkpoint directory is not shared across hosts.
+
+        The multi-host commit rendezvous is filesystem-based (module
+        docstring): process 0 polls for every host's ``files.p*.json``
+        sentinel before writing COMMIT. On disjoint local disks that
+        protocol can never succeed — every save would time out after 600 s
+        and no checkpoint would ever commit, silently. Probe at init
+        instead: process 0 writes a nonce file, and every host must observe
+        it (with a short poll to ride out NFS attribute-cache latency).
+        Runs on the main thread; uses host-level collectives only.
+        """
+        from jax.experimental import multihost_utils
+
+        probe = os.path.join(self.directory, ".fs_probe")
+        nonce = np.int32(np.random.randint(1 << 30))
+        if not distributed.is_main_process():
+            nonce = np.int32(0)
+        nonce = int(multihost_utils.broadcast_one_to_all(nonce))
+        if distributed.is_main_process():
+            with open(probe + ".tmp", "w") as fh:
+                fh.write(str(nonce))
+            os.replace(probe + ".tmp", probe)
+        distributed.barrier("ckpt_fs_probe_written")
+        deadline = time.monotonic() + 15.0
+        seen = False
+        while time.monotonic() < deadline:
+            try:
+                with open(probe) as fh:
+                    seen = fh.read().strip() == str(nonce)
+            except OSError:
+                seen = False
+            if seen:
+                break
+            time.sleep(0.25)
+        all_seen = multihost_utils.process_allgather(
+            np.asarray(seen, np.bool_))
+        if distributed.is_main_process():
+            try:
+                os.remove(probe)
+            except OSError:
+                pass
+        if not np.all(all_seen):
+            missing = [i for i, ok in enumerate(np.atleast_1d(all_seen))
+                       if not ok]
+            raise RuntimeError(
+                f"checkpoint directory {self.directory!r} is not visible "
+                f"from host process(es) {missing}: the multi-host commit "
+                f"rendezvous requires a SHARED filesystem (NFS/GCS fuse). "
+                f"Point --checkpoint-dir at storage all hosts can read, or "
+                f"run single-host.")
 
     def _recover_interrupted_replace(self):
         """Heal a crash inside save()'s re-save swap: a ``step_X.old`` dir

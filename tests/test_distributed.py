@@ -98,3 +98,86 @@ def test_launcher_requires_command():
                          cwd=REPO, timeout=60)
     assert res.returncode != 0
     assert "no command" in res.stderr
+
+
+@pytest.mark.slow
+def test_disjoint_checkpoint_dir_fails_fast(tmp_path):
+    """VERDICT r2 weak #5: the commit rendezvous assumes a shared
+    filesystem. Pointing each rank at a different directory must raise at
+    Checkpointer init (fail-fast), not time out 600s per save later."""
+    script = tmp_path / "disjoint_ck.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # sitecustomize pins axon
+        from pytorch_distributed_training_example_tpu.core import checkpoint, distributed
+        distributed.init_process_group()
+        rank_dir = os.path.join(%r, f"rank_{jax.process_index()}")
+        os.makedirs(rank_dir, exist_ok=True)
+        try:
+            checkpoint.Checkpointer(rank_dir)
+        except RuntimeError as e:
+            assert "SHARED filesystem" in str(e), e
+            print("FS_VALIDATION_RAISED", flush=True)
+            sys.exit(7)
+        print("no error", flush=True)
+    """) % (REPO, str(tmp_path)))
+    res = _run_launch(2, [str(script)], timeout=120)
+    assert res.returncode == 7, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "FS_VALIDATION_RAISED" in res.stdout
+
+
+@pytest.mark.slow
+def test_shared_checkpoint_dir_passes_validation(tmp_path):
+    """Same probe, shared directory: validation is silent and save works."""
+    script = tmp_path / "shared_ck.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # sitecustomize pins axon
+        from pytorch_distributed_training_example_tpu.core import checkpoint, distributed
+        distributed.init_process_group()
+        ck = checkpoint.Checkpointer(os.path.join(%r, "shared"))
+        print("FS_VALIDATION_OK", flush=True)
+    """) % (REPO, str(tmp_path)))
+    res = _run_launch(2, [str(script)], timeout=120)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "FS_VALIDATION_OK" in res.stdout
+
+
+@pytest.mark.slow
+def test_multihost_eval_agreement(tmp_path):
+    """VERDICT r2 weak #6: evaluate() divides global metric sums on the host
+    per-process; every host must arrive at the SAME numbers (eval batches
+    are globally sharded, eval_stats returns global sums). Non-main ranks
+    suppress logging, so each rank prints its result directly."""
+    script = tmp_path / "eval_agree.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # sitecustomize pins axon
+        from pytorch_distributed_training_example_tpu.core import distributed
+        from pytorch_distributed_training_example_tpu.core.trainer import Trainer
+        from pytorch_distributed_training_example_tpu.utils.config import from_preset
+        distributed.init_process_group()
+        cfg = from_preset("resnet18_cifar10", global_batch_size=16,
+                          steps_per_epoch=2, epochs=1, workers=0,
+                          checkpoint_dir=%r)
+        t = Trainer(cfg)
+        avg = t.evaluate(0)
+        print("EVALRES", jax.process_index(),
+              sorted((k, round(v, 6)) for k, v in avg.items()), flush=True)
+    """) % (REPO, str(tmp_path / "ck")))
+    res = _run_launch(2, [str(script)], timeout=240)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    lines = [l for l in (res.stdout + res.stderr).splitlines()
+             if l.startswith("EVALRES")]
+    with open("/tmp/launch_rank1.log") as fh:
+        lines += [l for l in fh.read().splitlines() if l.startswith("EVALRES")]
+    results = {l.split()[1]: l.split(" ", 2)[2] for l in lines}
+    assert set(results) == {"0", "1"}, lines
+    assert results["0"] == results["1"], (
+        f"hosts disagree on eval metrics: {results}")
