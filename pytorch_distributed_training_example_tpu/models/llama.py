@@ -214,6 +214,21 @@ def llama3_8b(**kw) -> Llama:
     return Llama(**kw)
 
 
+def llama_400m(**kw) -> Llama:
+    """One-chip bench scale: full Llama architecture (GQA 4:1, RoPE,
+    SwiGLU, RMSNorm) at ~400M params so the family has a measured
+    single-v5e perf row (BENCH_LLAMA.json) alongside the 8B feasibility
+    artifact. Llama-2-sized vocab keeps embeddings from dominating."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("num_layers", 16)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("num_kv_heads", 4)
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("ffn_dim", 4096)
+    kw.setdefault("max_seq_len", 2048)
+    return Llama(**kw)
+
+
 def llama_tiny(**kw) -> Llama:
     """Test-scale Llama (same architecture, toy dims)."""
     kw.setdefault("vocab_size", 512)

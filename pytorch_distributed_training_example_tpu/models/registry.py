@@ -132,6 +132,18 @@ def _llama3_8b(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto"
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
+@register("llama_400m")
+def _llama_400m(*, seq_len, dtype, param_dtype, remat, sp=False,
+                attn_impl="auto", logits_dtype, **_):
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    module = llama.llama_400m(dtype=dtype, param_dtype=param_dtype,
+                              remat=remat, max_seq_len=max(seq_len, 2048),
+                              sp=sp, attn_impl=attn_impl,
+                              logits_dtype=logits_dtype)
+    return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
+
+
 @register("llama_tiny")
 def _llama_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
                 logits_dtype, **_):
