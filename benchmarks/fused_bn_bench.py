@@ -68,15 +68,15 @@ def _timed_pair(make_un, make_fu, *args, reps=3):
     uncorrelated drift then inflates both arms equally instead of flipping
     the ratio between runs.
     """
-    compiled = {}
+    loops = {}
     for tag, mk in (("un", make_un), ("fu", make_fu)):
         for L in (ITERS_SHORT, ITERS_LONG):
-            compiled[tag, L] = mk(L)
-    best = {k: float("inf") for k in compiled}
+            loops[tag, L] = mk(L)
+    best = {k: float("inf") for k in loops}
     times = {("un", ITERS_SHORT): [], ("un", ITERS_LONG): [],
              ("fu", ITERS_SHORT): [], ("fu", ITERS_LONG): []}
     for _ in range(reps):
-        for key, fn in compiled.items():
+        for key, fn in loops.items():
             t = _timed_at(fn, *args)
             best[key] = min(best[key], t)
             times[key].append(round(t * 1e3, 1))

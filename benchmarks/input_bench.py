@@ -39,12 +39,10 @@ def main(argv=None):
 
     from bench import bench_input
 
-    import json as _json
-
     golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "golden.json")
     with open(golden_path) as f:
-        golden = _json.load(f)
+        golden = json.load(f)
     device_rate = (golden.get("TPU v5 lite", {})
                    .get("resnet50_imagenet_train_throughput", {})
                    .get("value"))

@@ -98,9 +98,8 @@ class Checkpointer:
         from jax.experimental import multihost_utils
 
         probe = os.path.join(self.directory, ".fs_probe")
-        nonce = np.int32(np.random.randint(1 << 30))
-        if not distributed.is_main_process():
-            nonce = np.int32(0)
+        nonce = np.int32(np.random.randint(1 << 30)
+                         if distributed.is_main_process() else 0)
         nonce = int(multihost_utils.broadcast_one_to_all(nonce))
         if distributed.is_main_process():
             with open(probe + ".tmp", "w") as fh:
