@@ -101,4 +101,4 @@ class PipelinedLlama:
         logits = nn.Dense(m.vocab_size, use_bias=False, dtype=m.dtype,
                           param_dtype=m.param_dtype).apply(
             {"params": params["lm_head"]}, x)
-        return logits.astype(jnp.float32)
+        return logits.astype(m.logits_dtype)
