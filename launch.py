@@ -36,6 +36,9 @@ def main(argv=None):
     p.add_argument("--coordinator-port", type=int, default=None)
     p.add_argument("--cpu-devices", type=int, default=0,
                    help="fake CPU devices per process (testing without TPUs)")
+    p.add_argument("--log-dir", default="/tmp",
+                   help="directory for non-rank-0 stdout/stderr logs "
+                        "(launch_rankN.log)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- script.py args...")
     args = p.parse_args(argv)
@@ -44,6 +47,7 @@ def main(argv=None):
         p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
 
     port = args.coordinator_port or free_port()
+    os.makedirs(args.log_dir, exist_ok=True)
     procs = []
     for rank in range(args.nprocs):
         env = os.environ.copy()
@@ -64,7 +68,8 @@ def main(argv=None):
         if rank == 0:
             out = err = None
         else:
-            out = err = open(f"/tmp/launch_rank{rank}.log", "w")
+            out = err = open(
+                os.path.join(args.log_dir, f"launch_rank{rank}.log"), "w")
         procs.append(subprocess.Popen([sys.executable, *cmd], env=env,
                                       stdout=out, stderr=err))
 

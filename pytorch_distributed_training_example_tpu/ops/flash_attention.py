@@ -38,6 +38,25 @@ DEFAULT_BLOCK_KV = 1024
 LSE_LANES = 8  # lse stored [B,H,S,8]: minor dims satisfy Mosaic tiling
 
 
+def _fit_block(s: int, requested: int) -> int:
+    """Largest divisor of ``s`` that is <= ``requested``.
+
+    DEFAULT_BLOCK_Q/KV are preferences, not contracts: ``_flash_eligible``
+    admits any S % 512 == 0, so S=2560 under a 1024 default must tile at
+    640 — flooring the grid instead (Sq // block) would silently drop the
+    trailing rows (dq unwritten, dk/dv missing contributions). Every
+    eligible S (% 512 == 0) lands on a block >= 512 (640, 704, 768...).
+    No alignment guarantee beyond divisibility is claimed — block_q/kv sit
+    on the second-minor (sublane) dim, where Mosaic handles any size and
+    512-divisible S gives at least 8-alignment in the worst case; odd
+    explicit S still gets an exact tiling (worst case 1).
+    """
+    b = min(requested, s)
+    while s % b:
+        b -= 1
+    return b
+
+
 def _mxu(x):
     """MXU operand dtype: bf16/fp32 as stored; fp16 upcast to fp32.
 
@@ -115,8 +134,8 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_kv: int):
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    block_q = min(block_q, Sq)
-    block_kv = min(block_kv, Skv)
+    block_q = _fit_block(Sq, block_q)
+    block_kv = _fit_block(Skv, block_kv)
     assert Sq % block_q == 0 and Skv % block_kv == 0, (Sq, Skv, block_q, block_kv)
     grid = (B, H, Sq // block_q, Skv // block_kv)
 
@@ -250,8 +269,9 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
     """q,k,v,o,g: [B,S,H,D] (kv already GQA-expanded); lse: [B,H,Sq]."""
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
-    block_q = min(block_q, Sq)
-    block_kv = min(block_kv, Skv)
+    block_q = _fit_block(Sq, block_q)
+    block_kv = _fit_block(Skv, block_kv)
+    assert Sq % block_q == 0 and Skv % block_kv == 0, (Sq, Skv, block_q, block_kv)
     sm_scale = 1.0 / math.sqrt(D)
     # delta_i = rowsum(dO * O): cheap elementwise+reduce, fused by XLA;
     # broadcast over LSE_LANES to match the kernel's tile layout.

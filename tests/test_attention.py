@@ -122,6 +122,47 @@ def test_flash_grads_interpret(impl):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_fit_block_falls_to_largest_divisor():
+    """Blocks must tile S exactly — flooring the grid drops rows (r3 advisor
+    high: S=2560 under the 1024 defaults silently lost the last 512 query
+    rows' gradients in _flash_bwd)."""
+    assert F._fit_block(2560, 1024) == 640
+    assert F._fit_block(3584, 1024) == 896
+    assert F._fit_block(1536, 1024) == 768
+    assert F._fit_block(1024, 1024) == 1024
+    assert F._fit_block(512, 1024) == 512
+    assert F._fit_block(96, 64) == 48
+
+
+def test_flash_indivisible_block_grads_interpret():
+    """S not divisible by the requested block: the online kernels must fall
+    to a fitting block and produce exact grads (every row written)."""
+    q, k, v = _qkv(B=1, S=96, H=2)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(
+            lambda *a: F.flash_attention(*a, True, 64, 64, "online").sum(),
+            argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("S,D", [(2560, 128), (3584, 128), (1536, 64)])
+def test_flash_eligible_shapes_trace(S, D):
+    """Every shape _flash_eligible admits (S % 512 == 0) must trace through
+    auto dispatch fwd+bwd with the default 1024 blocks — the r3 advisor found
+    S=3584/D=128 crashing at trace time and S=2560/D=128 tracing into a
+    row-dropping bwd grid. eval_shape runs the wrapper Python (plan choice,
+    block fitting, grid math, asserts) without compiling."""
+    q = jax.ShapeDtypeStruct((1, S, 4, D), jnp.bfloat16)
+    jax.eval_shape(
+        jax.grad(lambda a, b, c: F.flash_attention(a, b, c, True).sum()
+                 .astype(jnp.float32)),
+        q, q, q)
+
+
 def test_gqa_repeat():
     q, k, v = _qkv(H=8, Hkv=2)
     ref = A.dot_product_attention(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2))

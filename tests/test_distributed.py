@@ -16,10 +16,13 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launch(nprocs, script_args, timeout=240, cpu_devices=2):
+def _run_launch(nprocs, script_args, timeout=240, cpu_devices=2,
+                log_dir=None):
     cmd = [sys.executable, os.path.join(REPO, "launch.py"),
-           "--nprocs", str(nprocs), "--cpu-devices", str(cpu_devices),
-           "--", *script_args]
+           "--nprocs", str(nprocs), "--cpu-devices", str(cpu_devices)]
+    if log_dir is not None:
+        cmd += ["--log-dir", str(log_dir)]
+    cmd += ["--", *script_args]
     return subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
                           cwd=REPO)
 
@@ -171,11 +174,13 @@ def test_multihost_eval_agreement(tmp_path):
         print("EVALRES", jax.process_index(),
               sorted((k, round(v, 6)) for k, v in avg.items()), flush=True)
     """) % (REPO, str(tmp_path / "ck")))
-    res = _run_launch(2, [str(script)], timeout=240)
+    # Rank-1 log routed under tmp_path (r3 advisor: a shared hardcoded
+    # /tmp path can carry stale EVALRES lines across runs).
+    res = _run_launch(2, [str(script)], timeout=240, log_dir=tmp_path)
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     lines = [l for l in (res.stdout + res.stderr).splitlines()
              if l.startswith("EVALRES")]
-    with open("/tmp/launch_rank1.log") as fh:
+    with open(tmp_path / "launch_rank1.log") as fh:
         lines += [l for l in fh.read().splitlines() if l.startswith("EVALRES")]
     results = {l.split()[1]: l.split(" ", 2)[2] for l in lines}
     assert set(results) == {"0", "1"}, lines
