@@ -42,24 +42,21 @@ def make_synthetic_batch(bundle, global_batch, image_size, seq_len, num_classes)
     }
 
 
-def bench(model_name: str = "resnet50", image_size: int = 224,
-          per_chip_batch: int = 128, steps: int = 50, warmup: int = 10,
-          precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
-          strategy: str | None = None, mesh_spec: dict | None = None,
-          remat: bool = False, devices=None, attn_impl: str = "auto"):
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
+def setup_step(model_name: str = "resnet50", image_size: int = 224,
+               per_chip_batch: int = 128, precision: str = "bf16",
+               seq_len: int = 1024, strategy: str | None = None,
+               mesh_spec: dict | None = None, remat: bool = False,
+               devices=None, attn_impl: str = "auto"):
+    """Build (mesh, state, step_fn, device batch, bundle) exactly as the
+    benchmark measures them — shared by bench() and benchmarks/profile_step.py
+    so profiles describe the same program the headline numbers time."""
     from pytorch_distributed_training_example_tpu.core import (
         mesh as mesh_lib, optim, precision as precision_lib, train_loop)
     from pytorch_distributed_training_example_tpu.models import registry
     from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
-    from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
     from pytorch_distributed_training_example_tpu.utils.config import from_preset
 
     mesh = mesh_lib.build_mesh(mesh_spec or {"data": -1}, devices=devices)
-    n_chips = mesh.size
     global_batch = per_chip_batch * mesh_lib.dp_size(mesh)
     cfg = from_preset("resnet50_imagenet", global_batch_size=global_batch,
                       precision=precision)
@@ -85,6 +82,28 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                                  cfg.num_classes)
     from pytorch_distributed_training_example_tpu.data import prefetch
     batch = prefetch.shard_batch(batch, mesh_lib.batch_sharding(mesh))
+    return {"mesh": mesh, "state": state, "step": step, "batch": batch,
+            "bundle": bundle, "cfg": cfg, "strategy": strategy,
+            "global_batch": global_batch}
+
+
+def bench(model_name: str = "resnet50", image_size: int = 224,
+          per_chip_batch: int = 128, steps: int = 50, warmup: int = 10,
+          precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
+          strategy: str | None = None, mesh_spec: dict | None = None,
+          remat: bool = False, devices=None, attn_impl: str = "auto"):
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+    from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+
+    su = setup_step(model_name, image_size, per_chip_batch, precision, seq_len,
+                    strategy, mesh_spec, remat, devices, attn_impl)
+    mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
+                                        su["batch"], su["bundle"])
+    strategy, global_batch = su["strategy"], su["global_batch"]
+    n_chips = mesh.size
 
     @jax.jit
     def run_steps(state, batch):

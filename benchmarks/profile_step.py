@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Per-HLO step profile from an xplane trace (VERDICT r3 #1/#3 tooling).
+
+Profiles the SAME compiled train step bench.py times (shared setup via
+``bench.setup_step``), then parses the ``jax.profiler`` xplane dump into a
+per-op table and category rollup — the methodology behind PROFILE_GPT2.md /
+PROFILE_RN50.md, now a reusable script instead of a throwaway:
+
+    python benchmarks/profile_step.py --model vit_b16 --per-chip-batch 64 \
+        --out PROFILE_VIT.json
+
+Classification is NOT name-guessing: the compiled module's HLO text is
+parsed so every fusion is categorized by what its called computation
+actually contains (convolution > dot > scatter > reduce > elementwise,
+first match wins), and trace events are joined to that map by op name.
+Durations are measured device time — no cost model in the loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Category priority (first present wins) for a fused computation's body.
+_PRIORITY = ["attention_kernel", "conv", "matmul", "scatter", "gather",
+             "pool", "reduce"]
+
+
+def _body_category(body: str) -> str:
+    found = set()
+    for line in body.splitlines():
+        if "tpu_custom_call" in line or "mosaic" in line:
+            found.add("attention_kernel")
+        elif " convolution(" in line:
+            # XLA:TPU lowers big dot_generals to convolution instructions;
+            # the source metadata tells them apart from real convs.
+            found.add("matmul" if "dot_general" in line else "conv")
+        elif " dot(" in line:
+            found.add("matmul")
+        elif " scatter(" in line:
+            found.add("scatter")
+        elif " gather(" in line:
+            found.add("gather")
+        elif " reduce-window(" in line:
+            found.add("pool")
+        elif " reduce(" in line:
+            found.add("reduce")
+    for cat in _PRIORITY:
+        if cat in found:
+            return cat
+    return "elementwise"
+
+
+def _src_tag(line: str) -> str | None:
+    """Short source tag from metadata: last path components of op_name."""
+    m = re.search(r'op_name="([^"]+)"', line)
+    if not m:
+        return None
+    return "/".join(m.group(1).split("/")[-3:])
+
+
+def build_op_categories(hlo_text: str):
+    """Map every instruction name -> category using computation contents."""
+    # Split into computations: "%name (args) -> ret {\n ... \n}"
+    comp_bodies = {}
+    for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?:\.clone)? \([^)]*\) -> .*? \{\n(.*?)^\}",
+                         hlo_text, re.M | re.S):
+        comp_bodies[m.group(1)] = m.group(2)
+    comp_cat = {name: _body_category(body)
+                for name, body in comp_bodies.items()}
+
+    op_cat = {}
+    op_src = {}
+    for name, body in comp_bodies.items():
+        for line in body.splitlines():
+            # Result shapes may be tuples with spaces and one level of
+            # nested parens from layouts (T(8,128), S(1)); the opcode is
+            # the first lowercase token directly before a '(' after '='.
+            im = re.match(
+                r"\s+(?:ROOT )?%?([\w.\-]+) = .*?([a-z][a-z0-9\-]*)\(", line)
+            if not im:
+                continue
+            op, opcode = im.group(1), im.group(2)
+            src = _src_tag(line)
+            if src:
+                op_src[op] = src
+            if opcode == "fusion":
+                cm = re.search(r"calls=%?([\w.\-]+)", line)
+                op_cat[op] = comp_cat.get(cm.group(1), "elementwise") \
+                    if cm else "elementwise"
+            elif opcode == "custom-call":
+                op_cat[op] = ("attention_kernel"
+                              if "tpu_custom_call" in line else "custom_call")
+            elif opcode == "convolution":
+                op_cat[op] = "matmul" if "dot_general" in line else "conv"
+            elif opcode == "dot":
+                op_cat[op] = "matmul"
+            elif opcode in ("all-reduce", "all-gather", "reduce-scatter",
+                            "all-to-all", "collective-permute"):
+                op_cat[op] = "collective"
+            elif opcode.startswith("copy") or opcode in ("bitcast", "convert",
+                                                         "transpose", "reshape"):
+                op_cat[op] = "copy_layout"
+            else:
+                op_cat[op] = opcode
+    return op_cat, op_src
+
+
+def collect_ops(trace_dir: str):
+    """Aggregate XLA-op events across all device planes/steps in the dump."""
+    from jax.profiler import ProfileData
+
+    paths = sorted(glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    ops = collections.defaultdict(lambda: [0.0, 0])  # name -> [ns, count]
+    module_ns = 0.0
+    module_runs = 0
+    for path in paths:
+        pd = ProfileData.from_file(path)
+        for plane in pd.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            for line in plane.lines:
+                if line.name == "XLA Modules":
+                    for ev in line.events:
+                        module_ns += ev.duration_ns
+                        module_runs += 1
+                if line.name != "XLA Ops":
+                    continue
+                for ev in line.events:
+                    rec = ops[ev.name]
+                    rec[0] += ev.duration_ns
+                    rec[1] += 1
+    return ops, module_ns, module_runs
+
+
+def profile(model_name: str, *, image_size=224, per_chip_batch=64,
+            precision="bf16", seq_len=1024, strategy=None, remat=False,
+            attn_impl="auto", steps=3, trace_dir=None, top=25):
+    import jax
+
+    from bench import setup_step
+    from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+    from pytorch_distributed_training_example_tpu.utils import (
+        metrics as metrics_lib)
+
+    su = setup_step(model_name, image_size, per_chip_batch, precision,
+                    seq_len, strategy=strategy, remat=remat,
+                    attn_impl=attn_impl)
+    mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
+    bundle = su["bundle"]
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="xprof_")
+    with mesh_lib.use_mesh(mesh):
+        compiled = jax.jit(step).lower(state, batch).compile()
+        op_cat, op_src = build_op_categories(compiled.as_text())
+        state, m = compiled(state, batch)  # warm
+        jax.tree.map(lambda x: x.block_until_ready(), m)
+        jax.profiler.start_trace(trace_dir)
+        for _ in range(steps):
+            state, m = compiled(state, batch)
+        jax.tree.map(lambda x: x.block_until_ready(), m)
+        jax.profiler.stop_trace()
+
+    ops, module_ns, module_runs = collect_ops(trace_dir)
+    n_steps = module_runs or steps
+    cats = collections.defaultdict(lambda: [0.0, 0])
+    rows = []
+    total_ns = 0.0
+    unmatched_ns = 0.0
+    for name, (ns, count) in ops.items():
+        nm = re.match(r"%?([\w.\-]+) =", name)
+        op = nm.group(1) if nm else name
+        cat = op_cat.get(op)
+        if cat is None:
+            cat = "unmatched"
+            unmatched_ns += ns
+        cats[cat][0] += ns
+        cats[cat][1] += count
+        total_ns += ns
+        rows.append({"ms_per_step": ns / n_steps / 1e6,
+                     "count": count // n_steps, "category": cat,
+                     "src": op_src.get(op), "hlo": name[:300]})
+    rows.sort(key=lambda r: -r["ms_per_step"])
+    cat_rows = sorted(
+        ({"category": c, "ms_per_step": ns / n_steps / 1e6,
+          "pct": 100 * ns / total_ns, "ops_per_step": n // n_steps}
+         for c, (ns, n) in cats.items()),
+        key=lambda r: -r["ms_per_step"])
+
+    step_ms = total_ns / n_steps / 1e6
+    flops = bundle.fwd_flops_per_example * 3 * per_chip_batch
+    peak = metrics_lib.peak_flops_per_chip()
+    out = {
+        "model": model_name,
+        "device": jax.devices()[0].device_kind,
+        "per_chip_batch": per_chip_batch,
+        "precision": precision,
+        "attn_impl": attn_impl,
+        "steps_traced": n_steps,
+        "xla_ops_ms_per_step": round(step_ms, 2),
+        "module_ms_per_step": round(module_ns / max(module_runs, 1) / 1e6, 2),
+        "mfu_from_op_time": round(flops / (step_ms / 1e3) / peak, 4),
+        "unmatched_pct": round(100 * unmatched_ns / max(total_ns, 1), 2),
+        "categories": [{**r, "ms_per_step": round(r["ms_per_step"], 2),
+                        "pct": round(r["pct"], 1)} for r in cat_rows],
+        "top_ops": [{**r, "ms_per_step": round(r["ms_per_step"], 3)}
+                    for r in rows[:top]],
+        "trace_dir": trace_dir,
+    }
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="vit_b16")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--per-chip-batch", type=int, default=64)
+    p.add_argument("--precision", default="bf16")
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--strategy", default=None)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--attn-impl", default="auto")
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--out", default=None, help="write full JSON here")
+    args = p.parse_args(argv)
+    res = profile(args.model, image_size=args.image_size,
+                  per_chip_batch=args.per_chip_batch, precision=args.precision,
+                  seq_len=args.seq_len, strategy=args.strategy,
+                  remat=args.remat, attn_impl=args.attn_impl,
+                  steps=args.steps, top=args.top)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+    slim = {k: res[k] for k in ("model", "device", "xla_ops_ms_per_step",
+                                "module_ms_per_step", "mfu_from_op_time",
+                                "unmatched_pct")}
+    for c in res["categories"]:
+        print(json.dumps(c), file=sys.stderr)
+    print(json.dumps(slim))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

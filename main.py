@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attention kernel: Pallas flash, ring (context-"
                         "parallel), Ulysses all-to-all, or plain XLA")
     p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--dropout", type=float, default=None,
+                   help="model dropout rate (families that support it)")
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--workers", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)

@@ -55,7 +55,7 @@ class Trainer:
             seq_len=cfg.seq_len, dtype=self.policy.compute_dtype,
             param_dtype=self.policy.param_dtype, remat=cfg.remat,
             sp=cfg.strategy.endswith("_sp"), attn_impl=cfg.attn_impl,
-            logits_dtype=self.policy.logits_dtype)
+            dropout=cfg.dropout, logits_dtype=self.policy.logits_dtype)
 
         # data ------------------------------------------------------------
         vocab = getattr(self.bundle.module, "vocab_size", 50257)

@@ -42,24 +42,29 @@ def list_models() -> list[str]:
 def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  seq_len: int = 1024, dtype=jnp.bfloat16, param_dtype=jnp.float32,
                  remat: bool = False, sp: bool = False,
-                 attn_impl: str = "auto",
+                 attn_impl: str = "auto", dropout: float = 0.0,
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
     return _REGISTRY[name](
         num_classes=num_classes, image_size=image_size, seq_len=seq_len,
         dtype=dtype, param_dtype=param_dtype, remat=remat, sp=sp,
-        attn_impl=attn_impl, logits_dtype=logits_dtype,
+        attn_impl=attn_impl, dropout=dropout, logits_dtype=logits_dtype,
     )
 
 
 @register("vit_b16")
 def _vit_b16(*, num_classes, image_size, dtype, param_dtype, remat,
-             attn_impl="auto", **_):
+             attn_impl="auto", dropout=0.0, **_):
     from pytorch_distributed_training_example_tpu.models import vit
 
+    # dropout defaults to 0.0 for parity with the reference model factory
+    # (torchvision vit_b_16: dropout=0.0, attention_dropout=0.0). r4 profile
+    # found dropout=0.1 was costing ~25% of the ViT step: the threefry mask
+    # bits get rematerialized inside the weight-grad matmul fusions
+    # (PROFILE_VIT.md).
     module = vit.vit_b16(num_classes=num_classes, dtype=dtype,
-                         param_dtype=param_dtype, remat=remat, dropout=0.1,
+                         param_dtype=param_dtype, remat=remat, dropout=dropout,
                          attn_impl=attn_impl)
     return ModelBundle(
         module=module, task="classification",
@@ -71,12 +76,12 @@ def _vit_b16(*, num_classes, image_size, dtype, param_dtype, remat,
 
 @register("vit_tiny")
 def _vit_tiny(*, num_classes, image_size, dtype, param_dtype, remat,
-              attn_impl="auto", **_):
+              attn_impl="auto", dropout=0.0, **_):
     from pytorch_distributed_training_example_tpu.models import vit
 
     module = vit.vit_tiny(num_classes=num_classes, dtype=dtype,
                           param_dtype=param_dtype, remat=remat,
-                          attn_impl=attn_impl)
+                          dropout=dropout, attn_impl=attn_impl)
     return ModelBundle(
         module=module, task="classification",
         input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),

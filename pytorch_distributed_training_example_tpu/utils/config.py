@@ -32,6 +32,9 @@ class Config:
     grad_clip: float = 0.0
     # attention kernel: auto | xla | flash (Pallas) | ring (CP) | ulysses
     attn_impl: str = "auto"
+    # model regularization (0.0 matches torchvision factory defaults; the
+    # registry forwards it to families that support it, e.g. ViT)
+    dropout: float = 0.0
     # precision / memory
     precision: str = "bf16"
     remat: bool = False  # gradient checkpointing (reference configs[4])

@@ -25,6 +25,16 @@ def test_resnet_forward_shapes(name, size, classes):
     assert "batch_stats" in mutated
 
 
+def test_vit_dropout_plumbed_and_defaults_off():
+    """Reference parity: torchvision vit_b_16 defaults to dropout=0.0; the
+    r3 registry hardcoded 0.1 and paid ~25% of the step for it
+    (PROFILE_VIT.md). The rate must flow from create_model to the module."""
+    off = registry.create_model("vit_b16", num_classes=10)
+    assert off.module.dropout == 0.0
+    on = registry.create_model("vit_b16", num_classes=10, dropout=0.1)
+    assert on.module.dropout == 0.1
+
+
 def test_param_count_resnet18():
     bundle = registry.create_model("resnet18", num_classes=1000, image_size=224,
                                    dtype=jnp.float32, param_dtype=jnp.float32)
