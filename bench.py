@@ -372,6 +372,9 @@ def main(argv=None):
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "xla", "flash", "ring", "ring_zigzag",
                             "ulysses"])
+    p.add_argument("--no-measured-roofline", action="store_true",
+                   help="skip the xplane-measured roofline pass (resnet50 "
+                        "headline only; ~2 min extra)")
     p.add_argument("--include-input", action="store_true",
                    help="also measure loader-only and end-to-end throughput "
                         "over a real JPEG tree (synthetic if no --data-path)")
@@ -386,13 +389,35 @@ def main(argv=None):
                    quiet=not args.verbose, seq_len=args.seq_len,
                    strategy=args.strategy, remat=args.remat,
                    attn_impl=args.attn_impl)
+    if (args.model == "resnet50" and not args.no_measured_roofline):
+        # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer
+        # traffic from the scheduled HLO joined with xplane durations —
+        # replaces the cost-model upper bound that could exceed physical
+        # peak (the r3 936>819 GB/s inconsistency).
+        import jax
+
+        if jax.default_backend() != "cpu":
+            import os
+            import sys as _sys
+            _sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+            from profile_step import profile as _profile
+
+            prof = _profile(args.model, image_size=args.image_size,
+                            per_chip_batch=args.per_chip_batch,
+                            precision=args.precision, steps=3,
+                            strategy=args.strategy, remat=args.remat,
+                            attn_impl=args.attn_impl)
+            result["extra"]["roofline_measured"] = prof["roofline_measured"]
     if args.model == "resnet50" and not args.no_lm:
         # The ResNet-50 step is HBM-bound on small chips (see roofline
         # extras); record the compute-bound LM headline alongside it.
         import jax
 
         if jax.default_backend() != "cpu":
-            lm = bench("gpt2", per_chip_batch=16, steps=50, warmup=4,
+            # per-chip batch 24: r4 sweep peak with the chunked-bwd flash
+            # kernels (63.6% MFU vs 62.4% at the r3 batch of 16).
+            lm = bench("gpt2", per_chip_batch=24, steps=50, warmup=4,
                        precision=args.precision, seq_len=1024, quiet=True)
             result["extra"]["lm"] = {
                 "metric": lm["metric"], "value": lm["value"],

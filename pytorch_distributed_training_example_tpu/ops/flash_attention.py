@@ -347,7 +347,14 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_kv):
 # more than the skipped FLOPs. Dense causal tiles are the keeper here.
 # ---------------------------------------------------------------------------
 
-ONESHOT_BUDGET = 10 * 1024 * 1024  # ~16 MB VMEM/core minus operand buffers
+# Live-bytes budget for one-shot plans. r3 ran 10 MB ("16 MB VMEM minus
+# operand buffers"); r4's plan sweep (see PROFILE_GPT2.md r4 addendum)
+# measured that the 16.8 MB-modeled (G=2, bq=512) backward compiles and is
+# the fastest fwd+bwd combo at GPT-2 shapes — the cost model overstates
+# live bytes (softmax tiles reuse the score tile's registers), so the
+# effective ceiling is higher than 10 MB. 17 MB admits that plan while
+# still rejecting the plans that fail to compile.
+ONESHOT_BUDGET = 17 * 1024 * 1024
 
 
 def _oneshot_plan(H, Sq, Skv, D, *, bwd=False, forced=False):
@@ -376,7 +383,12 @@ def _oneshot_plan(H, Sq, Skv, D, *, bwd=False, forced=False):
             if bq > Sq or Sq % bq or bq < min_bq:
                 continue
             if cell * g * bq * Skv + g * kvbytes <= ONESHOT_BUDGET:
-                key = (g * bq, bq)  # maximize work per program, then fat bq
+                # Maximize work per program; on ties prefer MORE HEADS over
+                # fatter q tiles — measured at B16·H12·S1024·D64 (r4 plan
+                # sweep): (2,512) runs fwd+bwd 1.87 ms vs 2.49 ms for
+                # (1,1024) at the identical program count, the extra heads
+                # amortizing per-program DMA better than extra q rows.
+                key = (g * bq, g)
                 if best is None or key > best[0]:
                     best = (key, (g, bq))
                 break  # smaller bq only shrinks work per program
@@ -393,6 +405,43 @@ def _kv_len_mask(s, kv_len):
     """Mask keys at positions >= kv_len (padded keys; see ``kv_len`` docs)."""
     k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
     return jnp.where(k_pos < kv_len, s, NEG_INF)
+
+
+def _causal_mask_chunk(s, qi, block_q, k_base):
+    """Causal mask for a kv chunk whose global key offset is ``k_base``."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+# Per-direction switches for the chunked causal-skip path, set from e2e
+# GPT-2 A/B (3 reps each, PROFILE_GPT2.md r4 addendum): chunked BACKWARD
+# wins 117.2 -> 114.6 ms/step (exact lse-based chunks, ~25-37% of dot/exp
+# work skipped); chunked FORWARD loses ~5 ms (the online rescale chain +
+# scratch round-trips cost more than the skipped work at these shapes), so
+# the forward keeps the single dense-score formulation.
+CHUNK_FWD = False
+CHUNK_BWD = True
+
+
+def _oneshot_num_chunks(causal, kv_len, Skv, bq, *, enabled=True) -> int:
+    """kv chunks per program for the causal-skip path (1 = dense).
+
+    Causal one-shot programs waste ~(nq-1)/(2nq) of their dot/exp work on
+    fully-masked keys. r3 tried splitting into two kernel VARIANTS and the
+    dk/dv stitch + duplicate K/V reads lost more than the skipped FLOPs
+    (see "Tried and rejected" above). This splits WITHIN the program
+    instead: a python-unrolled chunk loop whose invisible chunks are
+    skipped via pl.when on the q-block index — no extra launches, no
+    stitch, K/V DMA unchanged. Chunks of 512 keys keep the per-chunk dots
+    MXU-sized; shapes that don't tile fall back to dense.
+    """
+    if not enabled or not causal or kv_len is not None:
+        return 1
+    for ck in (512, 256):
+        if Skv % ck == 0 and Skv // ck > 1:
+            return Skv // ck
+    return 1
 
 
 def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
@@ -417,6 +466,50 @@ def _oneshot_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0] = jnp.broadcast_to(lse, (*lse.shape[:2], LSE_LANES))
 
 
+def _oneshot_fwd_kernel_chunked(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                                m_s, l_s, acc_s, *,
+                                sm_scale, block_q, num_chunks):
+    """Causal one-shot forward with in-program kv-chunk skipping: online
+    softmax over unrolled chunks (state in VMEM scratch so it crosses
+    pl.when region boundaries); chunks entirely above the diagonal are
+    never computed."""
+    qi = pl.program_id(2)
+    G, Skv, D = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
+    ck = Skv // num_chunks
+    q = _mxu(q_ref[0])                            # [G, bq, D]
+
+    m_s[:] = jnp.full_like(m_s, NEG_INF)
+    l_s[:] = jnp.zeros_like(l_s)
+    acc_s[:] = jnp.zeros_like(acc_s)
+
+    for c in range(num_chunks):
+        @pl.when(c * ck < (qi + 1) * block_q)
+        def _chunk(c=c):
+            k_c = _mxu(k_ref[0, :, c * ck:(c + 1) * ck, :])
+            v_c = _mxu(v_ref[0, :, c * ck:(c + 1) * ck, :])
+            s = jax.lax.dot_general(q, k_c, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = _causal_mask_chunk(s * sm_scale, qi, block_q, c * ck)
+            m_prev = m_s[:, :, :1]                # [G, bq, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_s[:, :, :1] = l_s[:, :, :1] * corr + jnp.sum(p, axis=2,
+                                                           keepdims=True)
+            pv = jax.lax.dot_general(p.astype(v_c.dtype), v_c,
+                                     (((2,), (1,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            acc_s[:] = acc_s[:] * corr + pv
+            m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+
+    l = jnp.maximum(l_s[:, :, :1], 1e-30)
+    o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
+    # Only lane 0 of l_s carries the denominator — broadcast the lane-0
+    # lse over LSE_LANES rather than reading uninitialized lanes.
+    lse = m_s[:, :, :1] + jnp.log(l)
+    lse_ref[0] = jnp.broadcast_to(lse, (*lse.shape[:2], LSE_LANES))
+
+
 def _oneshot_fwd(q, k, v, *, causal, plan, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
@@ -425,9 +518,22 @@ def _oneshot_fwd(q, k, v, *, causal, plan, kv_len=None):
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     grid = (B, H // G, Sq // bq)
+    nc = _oneshot_num_chunks(causal, kv_len, Skv, bq, enabled=CHUNK_FWD)
+    if nc > 1:
+        kernel = functools.partial(
+            _oneshot_fwd_kernel_chunked, sm_scale=1.0 / math.sqrt(D),
+            block_q=bq, num_chunks=nc)
+        scratch = [pltpu.VMEM((G, bq, 128), jnp.float32),   # m
+                   pltpu.VMEM((G, bq, 128), jnp.float32),   # l
+                   pltpu.VMEM((G, bq, D), jnp.float32)]     # acc
+    else:
+        kernel = functools.partial(
+            _oneshot_fwd_kernel, sm_scale=1.0 / math.sqrt(D),
+            causal=causal, block_q=bq, kv_len=kv_len)
+        scratch = []
     out, lse = pl.pallas_call(
-        functools.partial(_oneshot_fwd_kernel, sm_scale=1.0 / math.sqrt(D),
-                          causal=causal, block_q=bq, kv_len=kv_len),
+        kernel,
+        scratch_shapes=scratch,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0)),
@@ -491,6 +597,60 @@ def _oneshot_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _oneshot_bwd_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, dq_ref, dk_ref, dv_ref,
+                                dk_acc, dv_acc, dq_acc, *,
+                                sm_scale, block_q, num_chunks):
+    """Causal one-shot backward with in-program kv-chunk skipping. Exact
+    (probabilities recomputed from the saved forward lse, so no online
+    state): invisible chunks contribute nothing to dq and nothing from
+    these queries to dk/dv."""
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    G, Skv, D = k_ref.shape[1], k_ref.shape[2], k_ref.shape[3]
+    ck = Skv // num_chunks
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    dq_acc[:] = jnp.zeros_like(dq_acc)
+    q = _mxu(q_ref[0])                            # [G, bq, D]
+    do = _mxu(do_ref[0])
+    lse = lse_ref[0][..., :1]                     # [G, bq, 1]
+    delta = delta_ref[0][..., :1]
+
+    for c in range(num_chunks):
+        @pl.when(c * ck < (qi + 1) * block_q)
+        def _chunk(c=c):
+            k_c = _mxu(k_ref[0, :, c * ck:(c + 1) * ck, :])
+            v_c = _mxu(v_ref[0, :, c * ck:(c + 1) * ck, :])
+            s = jax.lax.dot_general(q, k_c, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = _causal_mask_chunk(s * sm_scale, qi, block_q, c * ck)
+            p = jnp.exp(s - lse)                  # [G, bq, ck]
+            dv_acc[:, c * ck:(c + 1) * ck, :] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v_c, (((2,), (2,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta) * sm_scale).astype(k_c.dtype)
+            dq_acc[:] += jax.lax.dot_general(
+                ds, k_c, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            dk_acc[:, c * ck:(c + 1) * ck, :] += jax.lax.dot_general(
+                ds, q, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+
+    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan, kv_len=None):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
@@ -505,17 +665,29 @@ def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan, kv_len=None):
     qspec = pl.BlockSpec((1, G, bq, D), lambda b, h, i: (b, h, i, 0))
     kspec = pl.BlockSpec((1, G, Skv, D), lambda b, h, i: (b, h, 0, 0))
     lspec = pl.BlockSpec((1, G, bq, LSE_LANES), lambda b, h, i: (b, h, i, 0))
+    nc = _oneshot_num_chunks(causal, kv_len, Skv, bq, enabled=CHUNK_BWD)
+    if nc > 1:
+        kernel = functools.partial(
+            _oneshot_bwd_kernel_chunked, sm_scale=1.0 / math.sqrt(D),
+            block_q=bq, num_chunks=nc)
+        scratch = [pltpu.VMEM((G, Skv, D), jnp.float32),   # dk
+                   pltpu.VMEM((G, Skv, D), jnp.float32),   # dv
+                   pltpu.VMEM((G, bq, D), jnp.float32)]    # dq
+    else:
+        kernel = functools.partial(
+            _oneshot_bwd_kernel, sm_scale=1.0 / math.sqrt(D),
+            causal=causal, block_q=bq, kv_len=kv_len)
+        scratch = [pltpu.VMEM((G, Skv, D), jnp.float32),
+                   pltpu.VMEM((G, Skv, D), jnp.float32)]
     dq, dk, dv = pl.pallas_call(
-        functools.partial(_oneshot_bwd_kernel, sm_scale=1.0 / math.sqrt(D),
-                          causal=causal, block_q=bq, kv_len=kv_len),
+        kernel,
         grid=(B, H // G, Sq // bq),
         in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
         out_specs=(qspec, kspec, kspec),
         out_shape=(jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
                    jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
                    jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype)),
-        scratch_shapes=[pltpu.VMEM((G, Skv, D), jnp.float32),
-                        pltpu.VMEM((G, Skv, D), jnp.float32)],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -550,11 +722,25 @@ def flash_attention(q, k, v, causal: bool = False,
     return out
 
 
+def _auto_uses_oneshot(H, Sq, Skv, D) -> bool:
+    """Auto dispatch is all-or-nothing across fwd+bwd: mixed one-shot-fwd
+    + online-bwd measured SLOWER than all-online at the shapes where only
+    the forward plan fits (llama_400m S=4096: 103.9 vs 97.9 ms/step, r4) —
+    the forward pays the dense-score waste without the backward's win."""
+    return (_oneshot_plan(H, Sq, Skv, D) is not None
+            and _oneshot_plan(H, Sq, Skv, D, bwd=True) is not None)
+
+
 def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len):
     B, Sq, H, D = q.shape
+    if kv_len is not None and impl == "online":
+        raise ValueError("kv_len masking requires the one-shot kernels; "
+                         "impl='online' cannot serve it")
     plan = None
-    if impl in ("auto", "oneshot"):
+    if impl == "oneshot" or kv_len is not None:
         plan = _oneshot_plan(H, Sq, k.shape[1], D, forced=impl == "oneshot")
+    elif impl == "auto" and _auto_uses_oneshot(H, Sq, k.shape[1], D):
+        plan = _oneshot_plan(H, Sq, k.shape[1], D)
     if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(f"oneshot flash attention cannot tile "
                          f"Sq={Sq}, Skv={k.shape[1]}, D={D} within VMEM"
@@ -579,10 +765,16 @@ def _vjp_bwd(causal, block_q, block_kv, impl, kv_len, res, g):
     H, Hkv = q.shape[2], k.shape[2]
     ke = attn_lib._repeat_kv(k, H)
     ve = attn_lib._repeat_kv(v, H)
+    if kv_len is not None and impl == "online":
+        raise ValueError("kv_len masking requires the one-shot kernels; "
+                         "impl='online' cannot serve it")
     plan = None
-    if impl in ("auto", "oneshot"):
+    if impl == "oneshot" or kv_len is not None:
         plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True,
                              forced=impl == "oneshot")
+    elif impl == "auto" and _auto_uses_oneshot(H, q.shape[1], ke.shape[1],
+                                               q.shape[3]):
+        plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True)
     if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(
             f"oneshot flash attention backward cannot tile Sq={q.shape[1]}, "

@@ -53,6 +53,7 @@ def test_zigzag_ring_matches_oracle(devices, ctx):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~40-105s compile on the 1-core CI host (r4 suite-budget pass)
 def test_zigzag_ring_gqa_tp_and_grads(devices):
     mesh = mesh_lib.build_mesh({"context": 4, "model": 2})
     q, k, v = _qkv(H=4, Hkv=2)
@@ -163,6 +164,42 @@ def test_flash_eligible_shapes_trace(S, D):
         q, q, q)
 
 
+def test_oneshot_chunked_bwd_grads_interpret():
+    """The chunked causal-skip backward (engages at Skv >= 1024 when
+    CHUNK_BWD) must match the oracle exactly — invisible chunks skipped,
+    visible diagonal chunks masked per-chunk (r4 kernel)."""
+    assert F.CHUNK_BWD and not F.CHUNK_FWD  # measured defaults, r4
+    assert F._oneshot_num_chunks(True, None, 1024, 256) == 2
+    q, k, v = _qkv(B=1, S=1024, H=2, D=16)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(
+            lambda *a: F.flash_attention(*a, True, 1024, 1024, "oneshot").sum(),
+            argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_oneshot_chunked_fwd_parity_interpret(monkeypatch):
+    """The chunked forward ships gated OFF (measured ~5 ms slower e2e,
+    PROFILE_GPT2.md r4) but must stay correct — including the lse output
+    all LSE_LANES wide — so flipping CHUNK_FWD is safe to re-measure."""
+    monkeypatch.setattr(F, "CHUNK_FWD", True)
+    q, k, v = _qkv(B=1, S=1024, H=2, D=16)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    with pltpu.force_tpu_interpret_mode():
+        out, lse = F._fwd_dispatch(q, k, v, True, 1024, 1024, "oneshot", None)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+    # every lse lane must carry the same (real) value
+    lse = np.asarray(lse)
+    np.testing.assert_allclose(lse, lse[..., :1].repeat(lse.shape[-1], -1),
+                               rtol=0, atol=0)
+    assert np.isfinite(lse).all()
+
+
 def test_gqa_repeat():
     q, k, v = _qkv(H=8, Hkv=2)
     ref = A.dot_product_attention(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2))
@@ -228,16 +265,21 @@ def test_padded_flash_grads(causal):
 
 
 def test_oneshot_plan_dispatch_thresholds():
-    """Lock in the measured auto-dispatch map (BENCH_FLASH_MICRO.json):
-    GPT-2 shapes get one-shot plans; Llama long-context shapes fall back
-    to the online kernels under auto but stay forceable."""
-    # GPT-2: B16-H12-S1024-D64 — one-shot wins (fwd and bwd plans exist)
-    assert F._oneshot_plan(12, 1024, 1024, 64) is not None
-    assert F._oneshot_plan(12, 1024, 1024, 64, bwd=True) is not None
-    # Llama: S4096-D128 — degenerate thin-tile plans rejected under auto...
-    assert F._oneshot_plan(16, 4096, 4096, 128) is None
+    """Lock in the measured auto-dispatch map (BENCH_FLASH_MICRO.json +
+    r4 A/Bs): one-shot under auto only when BOTH directions have plans
+    (mixed one-shot-fwd/online-bwd measured slower than all-online at
+    llama_400m S=4096); long-context shapes stay on the online kernels."""
+    # GPT-2: B16-H12-S1024-D64 — one-shot both directions
+    assert F._auto_uses_oneshot(12, 1024, 1024, 64)
+    # Llama-400m S=2048 D=128-class shapes: both plans exist (65.1% MFU r4)
+    assert F._auto_uses_oneshot(16, 2048, 2048, 128)
+    # S=4096: fwd plan exists at the r4 budget but bwd does not ->
+    # all-online under auto (the measured faster choice)
+    assert F._oneshot_plan(16, 4096, 4096, 128) is not None
     assert F._oneshot_plan(16, 4096, 4096, 128, bwd=True) is None
-    # ...but impl="oneshot" (forced) still finds a feasible tiling
+    assert not F._auto_uses_oneshot(16, 4096, 4096, 128)
+    assert not F._auto_uses_oneshot(16, 4096, 4096, 64)
+    # ...but impl="oneshot" (forced) still finds a feasible fwd tiling
     assert F._oneshot_plan(16, 4096, 4096, 128, forced=True) is not None
     # tiny sequences are exempt from the fatness threshold (tests use them)
     assert F._oneshot_plan(4, 64, 64, 16) is not None
