@@ -204,6 +204,7 @@ def test_native_jpeg_decode_error_counted(tmp_path):
     eng.close()
 
 
+@pytest.mark.slow  # ~40-105s compile on the 1-core CI host (r4 suite-budget pass)
 def test_resnet_trains_from_jpeg_tree(jpeg_tree, devices):
     """ResNet-50 takes real optimizer steps fed from a directory tree
     (driver-metric workload end to end, tiny shapes)."""

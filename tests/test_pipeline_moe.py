@@ -173,6 +173,7 @@ def test_moe_llama_end_to_end_ep(devices):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow  # ~40-105s compile on the 1-core CI host (r4 suite-budget pass)
 def test_pipelined_llama_matches_sequential(devices):
     """Strategy 'pp': full Llama forward/backward through the GPipe schedule
     equals the plain scan-layers model."""
