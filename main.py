@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--dropout", type=float, default=None,
                    help="model dropout rate (families that support it)")
+    p.add_argument("--tensorboard-dir", type=str, default=None,
+                   dest="tensorboard_dir",
+                   help="export metric scalars as TensorBoard events here")
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--workers", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)

@@ -45,7 +45,8 @@ class Trainer:
         self.cfg = cfg
         self.metric_logger = setup_logging(
             jsonl_path=os.path.join(cfg.checkpoint_dir, "metrics.jsonl")
-            if cfg.checkpoint_dir else None)
+            if cfg.checkpoint_dir else None,
+            tensorboard_dir=cfg.tensorboard_dir)
 
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(cfg.mesh_config())
         self.policy = precision_lib.get_policy(cfg.precision)

@@ -186,31 +186,41 @@ def _resnet_micro(*, num_classes, image_size, dtype, param_dtype, **_):
     )
 
 
-@register("resnet18")
-def _resnet18(*, num_classes, image_size, dtype, param_dtype, **_):
-    from pytorch_distributed_training_example_tpu.models import resnet
+def _resnet_bundle(name):
+    """Torchvision-style ResNet family entries (reference model zoo:
+    ``torchvision.models.resnet{18,34,50,101,152}()``)."""
+    def build(*, num_classes, image_size, dtype, param_dtype, **_):
+        from pytorch_distributed_training_example_tpu.models import resnet
 
-    module = resnet.resnet18(num_classes=num_classes, dtype=dtype,
-                             param_dtype=param_dtype,
-                             small_images=image_size <= 64)
+        module = getattr(resnet, name)(num_classes=num_classes, dtype=dtype,
+                                       param_dtype=param_dtype,
+                                       small_images=image_size <= 64)
+        return ModelBundle(
+            module=module, task="classification",
+            input_template=(jnp.zeros((2, image_size, image_size, 3),
+                                      jnp.float32),),
+            fwd_flops_per_example=resnet.flops_per_image(name, image_size),
+            rules={},
+        )
+    return build
+
+
+for _name in ("resnet18", "resnet34", "resnet50", "resnet101", "resnet152"):
+    _REGISTRY[_name] = _resnet_bundle(_name)
+
+
+@register("vit_l16")
+def _vit_l16(*, num_classes, image_size, dtype, param_dtype, remat,
+             attn_impl="auto", dropout=0.0, **_):
+    from pytorch_distributed_training_example_tpu.models import vit
+
+    module = vit.vit_l16(num_classes=num_classes, dtype=dtype,
+                         param_dtype=param_dtype, remat=remat,
+                         dropout=dropout, attn_impl=attn_impl)
     return ModelBundle(
         module=module, task="classification",
         input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
-        fwd_flops_per_example=resnet.flops_per_image("resnet18", image_size),
-        rules={},
-    )
-
-
-@register("resnet50")
-def _resnet50(*, num_classes, image_size, dtype, param_dtype, **_):
-    from pytorch_distributed_training_example_tpu.models import resnet
-
-    module = resnet.resnet50(num_classes=num_classes, dtype=dtype,
-                             param_dtype=param_dtype,
-                             small_images=image_size <= 64)
-    return ModelBundle(
-        module=module, task="classification",
-        input_template=(jnp.zeros((2, image_size, image_size, 3), jnp.float32),),
-        fwd_flops_per_example=resnet.flops_per_image("resnet50", image_size),
-        rules={},
+        fwd_flops_per_example=vit.flops_per_image(image_size, 16, 24, 1024,
+                                                  4096),
+        rules={"fsdp_tp": vit.TP_RULES, "tp": vit.TP_RULES},
     )

@@ -136,8 +136,23 @@ def resnet_micro(num_classes: int = 10, **kw) -> ResNet:
                   num_classes=num_classes, **kw)
 
 
+def resnet34(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock,
+                  num_classes=num_classes, **kw)
+
+
 def resnet50(num_classes: int = 1000, **kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck,
+                  num_classes=num_classes, **kw)
+
+
+def resnet101(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck,
+                  num_classes=num_classes, **kw)
+
+
+def resnet152(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck,
                   num_classes=num_classes, **kw)
 
 
@@ -147,5 +162,7 @@ def flops_per_image(name: str, image_size: int = 224) -> float:
     Standard published figures: ResNet-50 @224 ~= 4.09 GFLOP (multiply-adds
     x2), ResNet-18 @224 ~= 1.81 GFLOP; scaled quadratically for other sizes.
     """
-    base = {"resnet18": 1.81e9, "resnet50": 4.09e9, "resnet_micro": 1.2e7}[name]
+    base = {"resnet18": 1.81e9, "resnet34": 3.66e9, "resnet50": 4.09e9,
+            "resnet101": 7.80e9, "resnet152": 11.51e9,
+            "resnet_micro": 1.2e7}[name]
     return base * (image_size / 224.0) ** 2

@@ -128,6 +128,15 @@ def vit_b16(**kw) -> ViT:
     return ViT(**kw)
 
 
+def vit_l16(**kw) -> ViT:
+    """ViT-L/16 (torchvision vit_l_16 architecture: 24 layers, d=1024)."""
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("mlp_dim", 4096)
+    return ViT(**kw)
+
+
 def vit_tiny(**kw) -> ViT:
     kw.setdefault("num_layers", 2)
     kw.setdefault("num_heads", 4)

@@ -334,9 +334,34 @@ def ulysses_attention(
                          and k.shape[2] % tp == 0) else None
     local_heads = q.shape[2] // (tp if h_ax else 1)
     if local_heads % c:
-        raise ValueError(
-            f"ulysses needs local heads ({local_heads}) divisible by {axis} "
-            f"shards ({c})")
+        # Head-pad so each TP shard's heads divide the context shards
+        # (r3 hard-errored here; README "Known limits"). Zero heads attend
+        # uniformly, their outputs are sliced off, and the slice's vjp
+        # drops their gradient contributions — exactness is tested. Cost:
+        # the padded heads do full attention compute (pad/H overhead).
+        # The pad target is a multiple of tp*c regardless of whether H
+        # divided tp before: this both keeps heads TP-sharded after the
+        # pad (h_ax=None would replicate all heads across the model axis)
+        # and guarantees the recursive call pads no further.
+        H = q.shape[2]
+        group = tp * c
+        h_pad = -(-H // group) * group
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ulysses_attention: %d heads not divisible by %s=%d%s; "
+            "zero-padding to %d heads (+%.0f%% attention compute). Ring "
+            "attention has no head constraint if this overhead matters.",
+            H, axis, c, f" x {head_axis}={tp}" if tp > 1 else "", h_pad,
+            100.0 * (h_pad - H) / H)
+        k = _repeat_kv(k, H)
+        v = _repeat_kv(v, H)
+        pad = ((0, 0), (0, 0), (0, h_pad - H), (0, 0))
+        out = ulysses_attention(
+            jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), mesh=mesh,
+            axis=axis, causal=causal, batch_axes=batch_axes,
+            head_axis=head_axis)
+        return out[:, :, :H]
 
     def local_fn(q, k, v):
         # [B, S/c, H', D] -> all_to_all -> [B, S, H'/c, D]

@@ -55,6 +55,9 @@ class Config:
     log_every: int = 50
     eval_every_epochs: int = 1
     checkpoint_dir: str | None = None
+    # TensorBoard scalar export dir (optional; JSONL is always written
+    # when checkpoint_dir is set)
+    tensorboard_dir: str | None = None
     checkpoint_every_epochs: int = 1
     resume: str | None = None  # path | "auto"
     evaluate: bool = False  # eval-only mode (main.py --evaluate)

@@ -18,7 +18,8 @@ import jax
 log = logging.getLogger("pdtx")
 
 
-def setup_logging(level: int = logging.INFO, jsonl_path: str | None = None) -> "MetricLogger":
+def setup_logging(level: int = logging.INFO, jsonl_path: str | None = None,
+                  tensorboard_dir: str | None = None) -> "MetricLogger":
     """Configure stdout logging on process 0 (other processes stay quiet)."""
     is_main = jax.process_index() == 0
     handler = logging.StreamHandler(sys.stdout)
@@ -27,26 +28,55 @@ def setup_logging(level: int = logging.INFO, jsonl_path: str | None = None) -> "
     log.handlers[:] = [handler]
     log.setLevel(level if is_main else logging.ERROR)
     log.propagate = False
-    return MetricLogger(jsonl_path if is_main else None)
+    return MetricLogger(jsonl_path if is_main else None,
+                        tensorboard_dir if is_main else None)
 
 
 class MetricLogger:
-    def __init__(self, jsonl_path: str | None = None):
+    """JSONL sink plus optional TensorBoard scalars (SURVEY.md §5 metrics:
+    "optional TensorBoard scalars"). TB is lazy and best-effort — if no
+    SummaryWriter implementation is importable the logger degrades to
+    JSONL-only with one warning."""
+
+    def __init__(self, jsonl_path: str | None = None,
+                 tensorboard_dir: str | None = None):
         self._fh = None
+        self._tb = None
+        self._step = 0
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._fh = open(jsonl_path, "a")
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception as e:  # no TB in this environment
+                log.warning("TensorBoard export disabled (%s)", e)
 
     def write(self, **metrics):
         if self._fh is not None:
             metrics.setdefault("time", time.time())
             self._fh.write(json.dumps(metrics, default=float) + "\n")
             self._fh.flush()
+        if self._tb is not None:
+            kind = metrics.get("kind", "train")
+            step = int(metrics.get("step", metrics.get("epoch", self._step)))
+            self._step = max(self._step, step) + (0 if "step" in metrics else 1)
+            for key, val in metrics.items():
+                if key in ("kind", "step", "time"):
+                    continue
+                if isinstance(val, bool) or not isinstance(val, (int, float)):
+                    continue
+                self._tb.add_scalar(f"{kind}/{key}", float(val), step)
 
     def close(self):
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
 
 class AverageMeter:

@@ -91,11 +91,54 @@ def test_ulysses_matches_oracle(devices, causal):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_ulysses_head_divisibility_error(devices):
+def test_ulysses_head_padding(devices):
+    """Heads not divisible by the context shards are zero-padded (r3
+    hard-errored here): values AND grads must match the oracle exactly —
+    the slice vjp drops the padded heads' contributions."""
     mesh = mesh_lib.build_mesh({"context": 8})
-    q, k, v = _qkv(H=4)
-    with pytest.raises(ValueError, match="divisible"):
-        A.ulysses_attention(q, k, v, mesh=mesh)
+    q, k, v = _qkv(H=4)  # 4 % 8 != 0 -> padded to 8
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    out = A.ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(
+        lambda *a: A.ulysses_attention(*a, mesh=mesh, causal=True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_head_padding_with_tp_pads_once(devices, caplog):
+    """H indivisible by BOTH tp and context: the pad target must be a
+    multiple of tp*c so the recursive call doesn't pad a second time
+    (r4 review finding: conditioning the pad group on the pre-pad h_ax
+    double-padded 6 heads to 16). One pad == one warning."""
+    import logging
+
+    mesh = mesh_lib.build_mesh({"model": 2, "context": 4})
+    q, k, v = _qkv(H=3)
+    ref = A.dot_product_attention(q, k, v, causal=True)
+    with caplog.at_level(logging.WARNING,
+                         logger="pytorch_distributed_training_example_tpu.ops.attention"):
+        out = A.ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+    pads = [r for r in caplog.records if "zero-padding" in r.message]
+    assert len(pads) == 1, [r.message for r in pads]
+
+
+def test_ulysses_head_padding_gqa(devices):
+    """GQA with indivisible Q heads: KV expands to full heads before the
+    pad so q-to-kv head grouping stays aligned."""
+    mesh = mesh_lib.build_mesh({"context": 4, "data": 2})
+    q, k, v = _qkv(H=6, Hkv=2)  # 6 % 4 != 0 -> padded to 8
+    ref = A.dot_product_attention(q, k, v, causal=False)
+    out = A.ulysses_attention(q, k, v, mesh=mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
 
 
 @pytest.mark.parametrize("impl", ["oneshot", "online"])

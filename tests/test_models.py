@@ -35,6 +35,21 @@ def test_vit_dropout_plumbed_and_defaults_off():
     assert on.module.dropout == 0.1
 
 
+@pytest.mark.parametrize("name,expected_m", [
+    ("resnet34", 21.80), ("resnet101", 44.55), ("resnet152", 60.19),
+    ("vit_l16", 304.33),
+])
+def test_param_counts_extended_zoo(name, expected_m):
+    """New zoo entries match the torchvision factories' published param
+    counts (resnet34/101/152, vit_l_16) within 1%."""
+    bundle = registry.create_model(name, num_classes=1000, image_size=224)
+    variables = jax.eval_shape(
+        lambda: bundle.module.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 224, 224, 3)), train=False))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    assert abs(n / 1e6 - expected_m) / expected_m < 0.01, n
+
+
 def test_param_count_resnet18():
     bundle = registry.create_model("resnet18", num_classes=1000, image_size=224,
                                    dtype=jnp.float32, param_dtype=jnp.float32)

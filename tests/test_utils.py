@@ -106,3 +106,30 @@ def test_mfu_accounting():
     mfu = metrics_lib.mfu(1000.0, 4.09e9, device=FakeDev())
     assert mfu == pytest.approx(3 * 4.09e12 / 197e12)
     assert metrics_lib.peak_hbm_gbps(FakeDev()) == 819.0
+
+
+def test_metric_logger_tensorboard_export(tmp_path):
+    """SURVEY.md §5 optional TensorBoard scalars: numeric metrics land as
+    event-file scalars tagged kind/name at the given step; non-numerics
+    are skipped; JSONL keeps working alongside."""
+    pytest.importorskip("tensorboard")
+    from pytorch_distributed_training_example_tpu.utils.logging import MetricLogger
+
+    tb = tmp_path / "tb"
+    ml = MetricLogger(jsonl_path=str(tmp_path / "m.jsonl"),
+                      tensorboard_dir=str(tb))
+    ml.write(kind="train", step=3, loss=1.5, acc_top1=0.25, note="skip-me")
+    ml.write(kind="eval", epoch=1, loss=2.0)
+    ml.close()
+
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator)
+
+    acc = EventAccumulator(str(tb))
+    acc.Reload()
+    tags = set(acc.Tags()["scalars"])
+    assert {"train/loss", "train/acc_top1", "eval/loss"} <= tags, tags
+    ev = acc.Scalars("train/loss")[0]
+    assert ev.step == 3 and abs(ev.value - 1.5) < 1e-6
+    assert "train/note" not in tags
+    assert (tmp_path / "m.jsonl").read_text().count("\n") == 2
