@@ -3,10 +3,12 @@
 correctness-only). Runs the standard bench train-step harness at a small
 per-chip batch sweep and records BENCH_VIT.json.
 
-ViT-B/16 at 224px has 197 tokens/image — not a multiple of 512, so the
-Pallas flash kernel is ineligible by design (ops/attention._flash_eligible)
-and attention runs the fused XLA path; the artifact records rows for
-``auto`` (XLA) attention across batches.
+ViT-B/16 at 224px has 197 tokens/image — below the ~1024-token threshold
+where the padded Pallas path pays (measured r3 AND re-measured r4 against
+the clean no-dropout baseline: 68.1 vs 63.4 ms/step), so ``auto``
+dispatches the fused XLA attention. Rows sweep per-chip batch; dropout is
+0.0 (torchvision factory parity — the r3 rows benchmarked a harder model,
+see PROFILE_VIT.md).
 
     python benchmarks/vit_bench.py [--out BENCH_VIT.json]
 """
