@@ -722,16 +722,26 @@ def flash_attention(q, k, v, causal: bool = False,
     return out
 
 
-def _auto_uses_oneshot(H, Sq, Skv, D) -> bool:
-    """Auto dispatch is all-or-nothing across fwd+bwd: mixed one-shot-fwd
-    + online-bwd measured SLOWER than all-online at the shapes where only
-    the forward plan fits (llama_400m S=4096: 103.9 vs 97.9 ms/step, r4) —
-    the forward pays the dense-score waste without the backward's win."""
-    return (_oneshot_plan(H, Sq, Skv, D) is not None
-            and _oneshot_plan(H, Sq, Skv, D, bwd=True) is not None)
-
-
 def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len):
+    """Auto dispatch is per-direction, from the r4 measured shape map
+    (BENCH_FLASH_MICRO.json):
+
+    - CAUSAL forward: the streaming online kernel wins at every measured
+      shape (0.54 vs 0.79 ms at B16·H12·S1024·D64; 0.72 vs 0.86 at
+      S2048; 1.37 vs 1.99 at S4096/D128) — its grid skips fully-masked
+      kv blocks and at default 1024-blocks the grid overhead that
+      motivated the one-shot kernels has collapsed to one program per
+      (batch, head, q-block).
+    - Backward: the one-shot chunked kernel wins whenever its plan fits
+      VMEM (2.37 vs 3.05 ms fwd+bwd at GPT-2 shapes); otherwise online.
+    - Non-causal forward: one-shot when a plan exists (no masked blocks
+      for the online grid to skip, so fewer/fatter programs win).
+
+    The two kernels share the residual format (q,k,v,o + lse
+    [B,H,S,LSE_LANES]), so mixing directions is free. The r3/r4-early
+    all-or-nothing rule is superseded by these per-direction
+    measurements; forced impl="oneshot"/"online" still pin both sides.
+    """
     B, Sq, H, D = q.shape
     if kv_len is not None and impl == "online":
         raise ValueError("kv_len masking requires the one-shot kernels; "
@@ -739,7 +749,7 @@ def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len):
     plan = None
     if impl == "oneshot" or kv_len is not None:
         plan = _oneshot_plan(H, Sq, k.shape[1], D, forced=impl == "oneshot")
-    elif impl == "auto" and _auto_uses_oneshot(H, Sq, k.shape[1], D):
+    elif impl == "auto" and not causal:
         plan = _oneshot_plan(H, Sq, k.shape[1], D)
     if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(f"oneshot flash attention cannot tile "
@@ -769,12 +779,11 @@ def _vjp_bwd(causal, block_q, block_kv, impl, kv_len, res, g):
         raise ValueError("kv_len masking requires the one-shot kernels; "
                          "impl='online' cannot serve it")
     plan = None
-    if impl == "oneshot" or kv_len is not None:
+    if impl in ("oneshot", "auto") or kv_len is not None:
+        # auto: one-shot backward whenever its plan fits (see
+        # _fwd_dispatch's dispatch-map docstring).
         plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True,
                              forced=impl == "oneshot")
-    elif impl == "auto" and _auto_uses_oneshot(H, q.shape[1], ke.shape[1],
-                                               q.shape[3]):
-        plan = _oneshot_plan(H, q.shape[1], ke.shape[1], q.shape[3], bwd=True)
     if plan is None and (impl == "oneshot" or kv_len is not None):
         raise ValueError(
             f"oneshot flash attention backward cannot tile Sq={q.shape[1]}, "
