@@ -144,6 +144,7 @@ def build_op_bytes(hlo_text: str):
     measured xplane durations by the caller, so only ops that really
     executed are summed."""
     op_bytes = {}
+    total_in = total_out = 0
     for m in re.finditer(
             r"^\s+(?:ROOT )?%?([\w.\-]+) = (.*?)([a-z][a-z0-9\-]*)\((.*)$",
             hlo_text, re.M):
@@ -157,9 +158,12 @@ def build_op_bytes(hlo_text: str):
         out_b = sum(_shape_bytes(d, s) for d, s in _SHAPE_RE.findall(result_txt))
         seen = set()
         in_b = 0
+        # '%' before operand names is optional: some XLA as_text() versions
+        # omit it, and requiring it would silently zero the operand-read
+        # term of the traffic model (ADVICE r4).
         for sm in re.finditer(
                 rf"({_DTYPE_PAT}\[[\d,]*\])"
-                r"(?:\{[^}]*\})?\s+%([\w.\-]+)", rest):
+                r"(?:\{[^}]*\})?\s+%?([\w.\-]+)", rest):
             shape_txt, name = sm.groups()
             if name in seen:
                 continue
@@ -167,6 +171,16 @@ def build_op_bytes(hlo_text: str):
             dm = _SHAPE_RE.match(shape_txt)
             in_b += _shape_bytes(dm.group(1), dm.group(2))
         op_bytes[op] = in_b + out_b
+        total_in += in_b
+        total_out += out_b
+    if total_out and total_in < 0.2 * total_out:
+        # Reads should dominate writes across a whole module; a tiny read
+        # term means the operand parse is missing this dump's format and
+        # the roofline would silently underreport HBM traffic.
+        print(f"WARNING: parsed operand-read bytes ({total_in/1e9:.2f} GB) "
+              f"implausibly small vs result bytes ({total_out/1e9:.2f} GB) "
+              "— HLO operand format likely unmatched; measured roofline "
+              "will underreport traffic", file=sys.stderr)
     return op_bytes
 
 

@@ -105,6 +105,8 @@ class Trainer:
         self.steps_per_epoch = len(self.train_loader)
         if cfg.steps_per_epoch:
             self.steps_per_epoch = min(self.steps_per_epoch, cfg.steps_per_epoch)
+        # epoch-keyed eval rows land on the global-step TensorBoard axis
+        self.metric_logger.steps_per_epoch = self.steps_per_epoch
 
         # optimizer / state ------------------------------------------------
         self.tx, self.schedule = optim.build_optimizer(cfg, self.steps_per_epoch)

@@ -46,7 +46,17 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
-    return _REGISTRY[name](
+    builder = _REGISTRY[name]
+    if dropout != 0.0:
+        import inspect
+
+        if "dropout" not in inspect.signature(builder).parameters:
+            raise ValueError(
+                f"model {name!r} does not implement dropout; --dropout "
+                f"{dropout} would be silently ignored (the Llama and ResNet "
+                "families have no dropout knob, matching the reference "
+                "factories)")
+    return builder(
         num_classes=num_classes, image_size=image_size, seq_len=seq_len,
         dtype=dtype, param_dtype=param_dtype, remat=remat, sp=sp,
         attn_impl=attn_impl, dropout=dropout, logits_dtype=logits_dtype,
@@ -106,22 +116,26 @@ def _lm_bundle(module, tp_rules, seq_len, n_params_fn):
 
 @register("gpt2")
 def _gpt2(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
-          logits_dtype, **_):
+          dropout=0.0, logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import gpt2
 
+    # GPT-2 carries the reference family's dropout (HF gpt2: resid/embd/attn
+    # pdrop 0.1, but 0.0 default here for bench parity with the other rows)
     module = gpt2.gpt2_124m(dtype=dtype, param_dtype=param_dtype, remat=remat,
                             max_seq_len=max(seq_len, 1024), sp=sp,
+                            dropout=dropout,
                             attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
 
 
 @register("gpt2_tiny")
 def _gpt2_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
-               logits_dtype, **_):
+               dropout=0.0, logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import gpt2
 
     module = gpt2.gpt2_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
                             max_seq_len=max(seq_len, 256), sp=sp,
+                            dropout=dropout,
                             attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, gpt2.TP_RULES, seq_len, gpt2.num_params)
 

@@ -42,7 +42,10 @@ class MetricLogger:
                  tensorboard_dir: str | None = None):
         self._fh = None
         self._tb = None
-        self._step = 0
+        self._steps: dict[str, int] = {}  # per-kind last x-value (ADVICE r4)
+        # When the trainer sets this, epoch-keyed rows (eval) are converted
+        # to the global-step axis so train and eval scalars are comparable.
+        self.steps_per_epoch: int | None = None
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._fh = open(jsonl_path, "a")
@@ -61,8 +64,17 @@ class MetricLogger:
             self._fh.flush()
         if self._tb is not None:
             kind = metrics.get("kind", "train")
-            step = int(metrics.get("step", metrics.get("epoch", self._step)))
-            self._step = max(self._step, step) + (0 if "step" in metrics else 1)
+            prev = self._steps.get(kind, -1)
+            if "step" in metrics:
+                step = int(metrics["step"])
+            elif "epoch" in metrics and self.steps_per_epoch:
+                # end-of-epoch row -> last global step of that epoch
+                step = (int(metrics["epoch"]) + 1) * self.steps_per_epoch - 1
+            elif "epoch" in metrics:
+                step = int(metrics["epoch"])
+            else:
+                step = prev + 1
+            self._steps[kind] = max(prev, step)
             for key, val in metrics.items():
                 if key in ("kind", "step", "time"):
                     continue

@@ -314,6 +314,12 @@ def test_oneshot_plan_dispatch_thresholds():
     # GPT-2 / Llama-class shapes: the one-shot backward plan exists
     assert F._oneshot_plan(12, 1024, 1024, 64, bwd=True) is not None
     assert F._oneshot_plan(16, 2048, 2048, 128, bwd=True) is not None
+    # r5 budget policy (ADVICE r4): the 16.8 MB GPT-2 backward plan is
+    # admitted via the measured allowlist, not a >VMEM global cap...
+    assert F._oneshot_plan(12, 1024, 1024, 64, bwd=True) == (2, 512)
+    # ...so an unmeasured same-band plan (S=2048/D=64 (1,512) = 16.7 MB)
+    # is no longer auto-admitted; the under-budget (1,256) is picked.
+    assert F._oneshot_plan(16, 2048, 2048, 64, bwd=True) == (1, 256)
     # S=4096: fwd plan exists at the r4 budget but bwd does not ->
     # backward streams online (the measured faster choice)
     assert F._oneshot_plan(16, 4096, 4096, 128) is not None

@@ -35,6 +35,18 @@ def test_vit_dropout_plumbed_and_defaults_off():
     assert on.module.dropout == 0.1
 
 
+def test_dropout_rejected_for_families_without_it():
+    """ADVICE r4: builders that have no dropout knob (Llama, ResNet —
+    matching their reference factories) must fail loudly on a nonzero
+    --dropout instead of silently swallowing it; GPT-2 implements it and
+    must plumb it through."""
+    for name in ("llama_tiny", "resnet18"):
+        with pytest.raises(ValueError, match="dropout"):
+            registry.create_model(name, seq_len=64, dropout=0.1)
+    on = registry.create_model("gpt2_tiny", seq_len=64, dropout=0.1)
+    assert on.module.dropout == 0.1
+
+
 @pytest.mark.parametrize("name,expected_m", [
     ("resnet34", 21.80), ("resnet101", 44.55), ("resnet152", 60.19),
     ("vit_l16", 304.33),

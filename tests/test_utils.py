@@ -133,3 +133,28 @@ def test_metric_logger_tensorboard_export(tmp_path):
     assert ev.step == 3 and abs(ev.value - 1.5) < 1e-6
     assert "train/note" not in tags
     assert (tmp_path / "m.jsonl").read_text().count("\n") == 2
+
+
+def test_metric_logger_tensorboard_step_axes(tmp_path):
+    """Eval rows (epoch-keyed) land on the global-step axis when the
+    trainer provides steps_per_epoch, so train/eval scalars are
+    comparable; per-kind counters never move backwards (ADVICE r4)."""
+    pytest.importorskip("tensorboard")
+    from pytorch_distributed_training_example_tpu.utils.logging import MetricLogger
+
+    tb = tmp_path / "tb"
+    ml = MetricLogger(tensorboard_dir=str(tb))
+    ml.steps_per_epoch = 100
+    ml.write(kind="train", epoch=0, step=99, loss=1.0)
+    ml.write(kind="eval", epoch=0, loss=2.0)    # -> global step 99
+    ml.write(kind="train", epoch=1, step=199, loss=0.5)
+    ml.write(kind="eval", epoch=1, loss=1.5)    # -> global step 199
+    ml.close()
+
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator)
+
+    acc = EventAccumulator(str(tb))
+    acc.Reload()
+    assert [e.step for e in acc.Scalars("eval/loss")] == [99, 199]
+    assert [e.step for e in acc.Scalars("train/loss")] == [99, 199]
