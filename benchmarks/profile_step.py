@@ -244,7 +244,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
 
     ops, module_ns, module_runs = collect_ops(trace_dir)
     n_steps = module_runs or steps
-    cats = collections.defaultdict(lambda: [0.0, 0])
+    cats = collections.defaultdict(lambda: [0.0, 0, 0])  # ns, count, bytes
     rows = []
     total_ns = 0.0
     unmatched_ns = 0.0
@@ -256,20 +256,30 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
         if cat is None:
             cat = "unmatched"
             unmatched_ns += ns
+        b = op_bytes.get(op, 0) * (count // max(n_steps, 1))
         cats[cat][0] += ns
         cats[cat][1] += count
+        cats[cat][2] += b
         total_ns += ns
-        b = op_bytes.get(op, 0) * (count // max(n_steps, 1))
         traffic_bytes += b
-        rows.append({"ms_per_step": ns / n_steps / 1e6,
+        op_ms = ns / n_steps / 1e6
+        rows.append({"ms_per_step": op_ms,
                      "count": count // n_steps, "category": cat,
                      "gbytes": round(b / 1e9, 3),
+                     "gbps": round(b / (op_ms * 1e6), 1) if op_ms else 0.0,
                      "src": op_src.get(op), "hlo": name[:300]})
     rows.sort(key=lambda r: -r["ms_per_step"])
+    # Per-category achieved bandwidth: category bytes over category device
+    # time. For memory-bound categories (reduce, elementwise, copy_layout)
+    # this is the sustained HBM rate; for MXU categories (conv, matmul) low
+    # GB/s just means the time went to math, so read those rows together
+    # with their share of step time, not as a bandwidth deficit.
     cat_rows = sorted(
         ({"category": c, "ms_per_step": ns / n_steps / 1e6,
-          "pct": 100 * ns / total_ns, "ops_per_step": n // n_steps}
-         for c, (ns, n) in cats.items()),
+          "pct": 100 * ns / total_ns, "ops_per_step": n // n_steps,
+          "gbytes_per_step": round(b / 1e9, 3),
+          "achieved_gbps": round(b * n_steps / ns, 1) if ns else 0.0}
+         for c, (ns, n, b) in cats.items()),
         key=lambda r: -r["ms_per_step"])
 
     step_ms = total_ns / n_steps / 1e6

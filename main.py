@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="cap steps per epoch (smoke/bench runs)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every-steps", type=int, default=None,
+                   help="also checkpoint every N optimizer steps (mid-epoch; "
+                        "resume continues at the exact next sample)")
     p.add_argument("--resume", default=None, nargs="?", const="auto",
                    help="checkpoint dir or 'auto' (newest committed)")
     p.add_argument("--evaluate", action="store_true",

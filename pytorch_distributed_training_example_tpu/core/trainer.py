@@ -138,7 +138,13 @@ class Trainer:
         # checkpointing ----------------------------------------------------
         self.checkpointer = (checkpoint_lib.Checkpointer(cfg.checkpoint_dir)
                              if cfg.checkpoint_dir else None)
+        if cfg.checkpoint_every_steps and self.checkpointer is None:
+            raise ValueError("--checkpoint-every-steps needs --checkpoint-dir "
+                             "(step-granular saves were requested but there "
+                             "is nowhere to write them)")
         self.start_epoch = 0
+        self.start_step_offset = 0
+        self._last_saved_step = -1
         self.resumed = False
         if cfg.resume and self.checkpointer is None:
             # --resume <path> without --checkpoint-dir: restore from (and
@@ -207,15 +213,35 @@ class Trainer:
                 log.info("resume requested but no committed checkpoint in %s", directory)
                 return
         self.state, extra = self.checkpointer.restore(self.state, step)
-        self.start_epoch = int(extra.get("epoch", -1)) + 1
+        epoch = int(extra.get("epoch", -1))
+        # Epoch-boundary checkpoints carry no step_offset (the epoch is
+        # complete); mid-epoch ones record how many steps of `epoch` were
+        # already applied, and the sampler — a pure function of
+        # (seed, epoch) — regenerates the identical permutation, so
+        # fast-forwarding the index stream is sample-exact.
+        offset = int(extra.get("step_offset", self.steps_per_epoch))
+        if offset < self.steps_per_epoch:
+            self.start_epoch = epoch
+            self.start_step_offset = offset
+            log.info("resumed from step %d (epoch %d, step offset %d)",
+                     step, epoch, offset)
+        else:
+            self.start_epoch = epoch + 1
+            self.start_step_offset = 0
+            log.info("resumed from step %d (epoch %d)", step, self.start_epoch)
         self.resumed = True
-        log.info("resumed from step %d (epoch %d)", step, self.start_epoch)
 
-    def _save(self, epoch: int):
+    def _save(self, epoch: int, step_offset: int | None = None):
         if self.checkpointer is None:
             return
         step = int(jax.device_get(self.state.step))
-        self.checkpointer.save(self.state, step, extra={"epoch": epoch})
+        if step == self._last_saved_step:
+            return  # the step cadence already wrote this exact state
+        extra = {"epoch": epoch}
+        if step_offset is not None:
+            extra["step_offset"] = step_offset
+        self.checkpointer.save(self.state, step, extra=extra)
+        self._last_saved_step = step
 
     # -- loops -------------------------------------------------------------
 
@@ -235,6 +261,10 @@ class Trainer:
     def train_epoch(self, epoch: int):
         cfg = self.cfg
         self.train_loader.set_epoch(epoch)
+        # Resumed mid-epoch: skip the already-trained prefix of this epoch's
+        # (deterministic) index stream; every later epoch starts at 0.
+        self.train_loader.start_batch = (
+            self.start_step_offset if epoch == self.start_epoch else 0)
         loss_m = AverageMeter("loss")
         tput = Throughput()
         t_step = time.perf_counter()
@@ -253,7 +283,7 @@ class Trainer:
     def _train_epoch_inner(self, epoch, it, loss_m, tput, t_step, watchdog):
         cfg = self.cfg
         with mesh_lib.use_mesh(self.mesh):
-            for i, batch in enumerate(it):
+            for i, batch in enumerate(it, self.train_loader.start_batch):
                 watchdog.beat()
                 if i >= self.steps_per_epoch:
                     break
@@ -269,6 +299,14 @@ class Trainer:
                 if self.profile_range and gstep == self.profile_range[0]:
                     jax.profiler.start_trace(cfg.profile_dir)
                 self.state, metrics = self.train_step(self.state, batch)
+                if (cfg.checkpoint_every_steps
+                        and (gstep + 1) % cfg.checkpoint_every_steps == 0):
+                    # Step-cadence save: records (epoch, steps applied) so
+                    # resume fast-forwards to the exact next sample. Runs
+                    # even at the epoch boundary — eval may take a long
+                    # time, and the boundary state must be durable before
+                    # it; the per-epoch save then dedupes on step id.
+                    self._save(epoch, step_offset=i + 1)
                 if self.profile_range and gstep + 1 == self.profile_range[1]:
                     jax.tree.map(lambda x: x.block_until_ready(), metrics)
                     jax.profiler.stop_trace()

@@ -10,6 +10,8 @@ the training loop into a bounded queue, and the device prefetcher
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
 from typing import Iterator
@@ -17,6 +19,21 @@ from typing import Iterator
 import numpy as np
 
 from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
+
+# Debug/verification hook: when this env var names a file, every loader
+# appends one JSON line per YIELDED batch ({"epoch", "batch", "indices"}).
+# Used by the mid-epoch-resume test to assert sample-exact continuation
+# (no replay, no skip); per-process file — point each rank somewhere else.
+INDEX_LOG_ENV = "PDTX_INDEX_LOG"
+
+
+def _log_indices(epoch: int, batch: int, indices) -> None:
+    path = os.environ.get(INDEX_LOG_ENV)
+    if not path:
+        return
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"epoch": int(epoch), "batch": int(batch),
+                             "indices": [int(i) for i in indices]}) + "\n")
 
 
 class _WorkerError:
@@ -94,19 +111,23 @@ class DataLoader:
         self.num_workers = num_workers
         self.drop_last = drop_last
         self.prefetch_batches = prefetch_batches
+        # Mid-epoch resume: skip this many leading batches of the epoch's
+        # index stream (never decoded, not just dropped). The trainer sets
+        # it for the resumed epoch and resets it to 0 for later epochs.
+        self.start_batch = 0
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
         if hasattr(self.dataset, "epoch"):
             self.dataset.epoch = epoch  # augmentations reseed per epoch
 
-    def _batches_of_indices(self):
+    def _batches_of_indices(self, start: int = 0):
         idx = self.sampler.local_indices()
         n_full = len(idx) // self.batch_size
-        for b in range(n_full):
+        for b in range(start, n_full):
             yield idx[b * self.batch_size : (b + 1) * self.batch_size]
         rem = len(idx) - n_full * self.batch_size
-        if rem and not self.drop_last:
+        if rem and not self.drop_last and start <= n_full:
             yield idx[n_full * self.batch_size :]
 
     def __len__(self) -> int:
@@ -117,16 +138,18 @@ class DataLoader:
         return collate([self.dataset[int(i)] for i in indices])
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        start = self.start_batch
         if self.num_workers <= 0:
-            for indices in self._batches_of_indices():
+            for b, indices in enumerate(self._batches_of_indices(start), start):
+                _log_indices(self.sampler.epoch, b, indices)
                 yield self._make_batch(indices)
             return
-        yield from self._threaded_iter()
+        yield from self._threaded_iter(start)
 
-    def _threaded_iter(self):
+    def _threaded_iter(self, start: int = 0):
         # Ordered hand-off: each worker owns batch b where b % W == worker_id,
         # writing into a per-batch slot so batch order is deterministic.
-        index_batches = list(self._batches_of_indices())
+        index_batches = list(self._batches_of_indices(start))
         out_q: list[queue.Queue] = [queue.Queue(maxsize=1) for _ in index_batches]
         budget = threading.Semaphore(max(self.prefetch_batches, self.num_workers))
         stop = threading.Event()
@@ -154,6 +177,7 @@ class DataLoader:
                 if isinstance(item, _WorkerError):
                     raise RuntimeError(
                         f"DataLoader worker failed on batch {b}") from item.exc
+                _log_indices(self.sampler.epoch, start + b, index_batches[b])
                 yield item
                 budget.release()
         finally:

@@ -20,6 +20,8 @@ import threading
 
 import numpy as np
 
+from pytorch_distributed_training_example_tpu.data import loader as loader_lib
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libbatch_engine.so"))
 
@@ -227,6 +229,10 @@ class NativeDataLoader:
         self.batch_size = batch_size
         self.prefetch = prefetch
         self.epoch = 0
+        # Mid-epoch resume: first batch of the epoch to produce (same
+        # contract as loader.DataLoader.start_batch — skipped batches are
+        # never submitted to the engine).
+        self.start_batch = 0
         self._next_id = 0  # globally monotonic: ids never reused across epochs
 
     @classmethod
@@ -295,11 +301,12 @@ class NativeDataLoader:
                 ids.append(cid)
             pending[b] = (ids, bi)
 
-        inflight = min(self.prefetch, nb)
-        for b in range(inflight):
+        start = min(self.start_batch, nb)
+        inflight = min(self.prefetch, nb - start)
+        for b in range(start, start + inflight):
             submit(b)
         try:
-            for b in range(nb):
+            for b in range(start, nb):
                 ids, bi = pending[b]
                 for cid in ids:
                     self.engine.wait(cid)
@@ -307,6 +314,7 @@ class NativeDataLoader:
                 batch = self._emit(bufs[b % self.prefetch], bi)
                 if b + inflight < nb:
                     submit(b + inflight)
+                loader_lib._log_indices(self.epoch, b, bi)
                 yield batch
         finally:
             # Drain in-flight jobs before `bufs` can be garbage-collected:

@@ -59,6 +59,11 @@ class Config:
     # when checkpoint_dir is set)
     tensorboard_dir: str | None = None
     checkpoint_every_epochs: int = 1
+    # 0 = epoch-boundary only. N > 0 also saves every N optimizer steps with
+    # the within-epoch offset recorded, so --resume restarts mid-epoch at the
+    # exact next unseen sample (the 8B-class configs cannot afford losing a
+    # days-long epoch to a failure; BASELINE.json configs[4]).
+    checkpoint_every_steps: int = 0
     resume: str | None = None  # path | "auto"
     evaluate: bool = False  # eval-only mode (main.py --evaluate)
     seed: int = 0

@@ -53,6 +53,48 @@ def test_build_dataset_synthetic_fallback():
     assert lm[0]["tokens"].shape == (64,)
 
 
+def test_loader_start_batch_skips_exact_prefix():
+    """Mid-epoch resume contract: start_batch=k yields exactly the suffix
+    of the epoch's deterministic batch stream, bit-for-bit, in both the
+    serial and threaded paths."""
+    ds = SyntheticImageDataset(96, 8, 10)
+    sampler = ShardedSampler(96, 1, 0, shuffle=True, seed=3)
+    full = list(DataLoader(ds, 8, sampler, num_workers=0))
+    for workers in (0, 2):
+        dl = DataLoader(ds, 8, ShardedSampler(96, 1, 0, shuffle=True, seed=3),
+                        num_workers=workers)
+        dl.start_batch = 5
+        tail = list(dl)
+        assert len(tail) == len(full) - 5
+        for a, b in zip(full[5:], tail):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_loader_index_log_records_absolute_batches(tmp_path, monkeypatch):
+    """PDTX_INDEX_LOG writes one line per yielded batch with the ABSOLUTE
+    batch number, so resumed runs can be compared against the full epoch
+    stream for the no-replay/no-skip assertion."""
+    import json
+
+    from pytorch_distributed_training_example_tpu.data import loader as loader_lib
+
+    log = tmp_path / "idx.jsonl"
+    monkeypatch.setenv(loader_lib.INDEX_LOG_ENV, str(log))
+    ds = SyntheticImageDataset(64, 8, 10)
+    sampler = ShardedSampler(64, 1, 0, shuffle=True, seed=7)
+    dl = DataLoader(ds, 8, sampler)
+    dl.set_epoch(2)
+    dl.start_batch = 3
+    list(dl)
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["batch"] for r in rows] == [3, 4, 5, 6, 7]
+    assert all(r["epoch"] == 2 for r in rows)
+    want = sampler.local_indices()[3 * 8:]
+    got = [i for r in rows for i in r["indices"]]
+    np.testing.assert_array_equal(got, want)
+
+
 def test_pad_batch_mask():
     b = {"image": np.ones((5, 4, 4, 3), np.float32), "label": np.arange(5)}
     out = prefetch.pad_batch(b, 8)

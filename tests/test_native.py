@@ -97,6 +97,25 @@ def test_native_dataloader_iterates():
     np.testing.assert_allclose(batches2[0]["image"], ref, atol=1e-5)
 
 
+def test_native_dataloader_start_batch_matches_suffix():
+    """start_batch (mid-epoch resume) on the native loader yields exactly
+    the suffix of the full epoch stream — same contract as DataLoader."""
+    imgs = np.random.RandomState(5).randint(0, 256, (48, 8, 8, 3), np.uint8)
+    labels = np.arange(48) % 10
+    mk = lambda: nl.NativeDataLoader(
+        imgs, labels, ShardedSampler(48, 1, 0, shuffle=True, seed=2,
+                                     drop_last=True),
+        batch_size=4, mean=[0.5] * 3, std=[0.25] * 3, augment=False)
+    full = list(mk())
+    dl = mk()
+    dl.start_batch = 7
+    tail = list(dl)
+    assert len(tail) == len(full) - 7
+    for a, b in zip(full[7:], tail):
+        np.testing.assert_array_equal(a["label"], b["label"])
+        np.testing.assert_allclose(a["image"], b["image"])
+
+
 def test_native_dataloader_early_abandon_drains():
     """Breaking out of iteration must not leave C++ jobs writing into freed bufs."""
     imgs = np.random.RandomState(4).randint(0, 256, (64, 8, 8, 3), np.uint8)
