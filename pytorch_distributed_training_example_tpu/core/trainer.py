@@ -138,10 +138,6 @@ class Trainer:
         # checkpointing ----------------------------------------------------
         self.checkpointer = (checkpoint_lib.Checkpointer(cfg.checkpoint_dir)
                              if cfg.checkpoint_dir else None)
-        if cfg.checkpoint_every_steps and self.checkpointer is None:
-            raise ValueError("--checkpoint-every-steps needs --checkpoint-dir "
-                             "(step-granular saves were requested but there "
-                             "is nowhere to write them)")
         self.start_epoch = 0
         self.start_step_offset = 0
         self._last_saved_step = -1
@@ -158,6 +154,13 @@ class Trainer:
                 # must not become a fresh empty checkpoint dir.
                 raise FileNotFoundError(f"--resume path not found: {cfg.resume}")
             self.checkpointer = checkpoint_lib.Checkpointer(root)
+        # After the resume path may have provided a save directory: the
+        # step cadence needs SOMEWHERE to write (mid-epoch resume + keep
+        # saving into the resume path is a supported combination).
+        if cfg.checkpoint_every_steps and self.checkpointer is None:
+            raise ValueError("--checkpoint-every-steps needs --checkpoint-dir "
+                             "or --resume <path> (step-granular saves were "
+                             "requested but there is nowhere to write them)")
         if cfg.resume and self.checkpointer:
             self._resume()
 

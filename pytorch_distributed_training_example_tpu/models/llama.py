@@ -247,10 +247,34 @@ def llama_moe_tiny(**kw) -> Llama:
     return llama_tiny(**kw)
 
 
+def llama_moe_400m(**kw) -> Llama:
+    """Bench-scale MoE Llama: the llama_400m backbone with its SwiGLU MLPs
+    replaced by 8-expert top-2 MoE blocks (~1.1B total params, ~400M-class
+    active compute per token) — the measured e2e EP row (BENCH_MOE.json)."""
+    kw.setdefault("num_experts", 8)
+    return llama_400m(**kw)
+
+
 def num_params(cfg: Llama) -> int:
     d, L, V = cfg.d_model, cfg.num_layers, cfg.vocab_size
     hd = cfg.head_dim
     attn = d * cfg.num_heads * hd + 2 * d * cfg.num_kv_heads * hd \
         + cfg.num_heads * hd * d
-    mlp = 3 * d * cfg.ffn_dim
+    if cfg.num_experts:
+        # MoE block: E stacked (w_up, w_down) expert FFNs + fp32 router
+        mlp = cfg.num_experts * 2 * d * cfg.ffn_dim + d * cfg.num_experts
+    else:
+        mlp = 3 * d * cfg.ffn_dim
     return V * d + L * (attn + mlp + 2 * d) + d + d * V
+
+
+def num_params_active(cfg: Llama, top_k: int = 2) -> int:
+    """Parameters touched per token — the honest FLOPs basis for MoE MFU
+    (6*N_active, PaLM-style): only the top_k routed experts' FFN weights
+    count, everything else as in the dense model."""
+    if not cfg.num_experts:
+        return num_params(cfg)
+    total = num_params(cfg)
+    per_expert = 2 * cfg.d_model * cfg.ffn_dim
+    inactive = (cfg.num_experts - top_k) * per_expert * cfg.num_layers
+    return total - inactive

@@ -183,7 +183,24 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False,
                                   remat=remat, max_seq_len=max(seq_len, 256),
                                   sp=sp, attn_impl=attn_impl,
                                   logits_dtype=logits_dtype)
-    return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
+    # MFU basis = ACTIVE params (top-2 experts), not the full expert stack
+    return _lm_bundle(module, llama.TP_RULES, seq_len,
+                      llama.num_params_active)
+
+
+@register("llama_moe")
+def _llama_moe(*, seq_len, dtype, param_dtype, remat, sp=False,
+               attn_impl="auto", logits_dtype, **_):
+    """Bench-scale MoE (llama_400m backbone, 8 experts top-2): the e2e EP
+    perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    module = llama.llama_moe_400m(dtype=dtype, param_dtype=param_dtype,
+                                  remat=remat, max_seq_len=max(seq_len, 2048),
+                                  sp=sp, attn_impl=attn_impl,
+                                  logits_dtype=logits_dtype)
+    return _lm_bundle(module, llama.TP_RULES, seq_len,
+                      llama.num_params_active)
 
 
 @register("resnet_micro")

@@ -62,6 +62,27 @@ def test_param_counts_extended_zoo(name, expected_m):
     assert abs(n / 1e6 - expected_m) / expected_m < 0.01, n
 
 
+def test_llama_moe_param_accounting():
+    """The MoE zoo entry's closed-form totals match real init, and the MFU
+    basis counts only ACTIVE (top-2) experts — an 8-expert MoE must not
+    claim the full expert stack as compute."""
+    from pytorch_distributed_training_example_tpu.models import llama
+
+    bundle = registry.create_model("llama_moe", seq_len=64)
+    variables = jax.eval_shape(
+        lambda: bundle.module.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 64), jnp.int32)))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    cfg = bundle.module
+    assert n == llama.num_params(cfg)
+    active = llama.num_params_active(cfg)
+    assert active < 0.4 * n  # 2-of-8 experts + shared trunk
+    dense = registry.create_model("llama_400m", seq_len=64)
+    # active-param flops basis is close to the dense backbone's (the MoE
+    # w_up/w_down pair differs from SwiGLU's three mats by d*ffn/layer)
+    assert abs(active - llama.num_params(dense.module)) < 0.2 * active
+
+
 def test_param_count_resnet18():
     bundle = registry.create_model("resnet18", num_classes=1000, image_size=224,
                                    dtype=jnp.float32, param_dtype=jnp.float32)
