@@ -36,6 +36,12 @@ def main():
     p.add_argument("--peak-tflops", type=float, default=197.0)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--out", default=None)
+    p.add_argument("--shapes", default=None,
+                   help="comma-separated BxHxSxD entries to run (default: "
+                        "all three LM shapes); lets long runs split across "
+                        "invocations — with --merge, rows append into --out")
+    p.add_argument("--merge", action="store_true",
+                   help="append rows into an existing --out file")
     args = p.parse_args()
 
     import jax
@@ -85,9 +91,12 @@ def main():
 
     import math
 
+    shapes = ((16, 12, 1024, 64), (4, 12, 2048, 64), (2, 16, 4096, 128))
+    if args.shapes:
+        shapes = tuple(tuple(int(x) for x in s.split("x"))
+                       for s in args.shapes.split(","))
     rows = []
-    for (B, H, S, D) in ((16, 12, 1024, 64), (4, 12, 2048, 64),
-                         (2, 16, 4096, 128)):
+    for (B, H, S, D) in shapes:
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
         k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
@@ -135,11 +144,29 @@ def main():
                              "frac_peak": round(tf / args.peak_tflops, 3)})
                 print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
 
+    measured = len(rows)
     if args.out:
+        doc = {"peak_tflops": args.peak_tflops}
+        if args.merge:
+            import os
+            if os.path.exists(args.out):
+                with open(args.out) as f:
+                    doc = json.load(f)  # preserve unknown sections verbatim
+                if doc.get("peak_tflops", args.peak_tflops) != args.peak_tflops:
+                    raise SystemExit(
+                        f"--merge: existing {args.out} is normalized to "
+                        f"peak_tflops={doc['peak_tflops']}, this run to "
+                        f"{args.peak_tflops}; frac_peak values would mix")
+                key = lambda r: (r["impl"], r["pass"], r["B"], r["H"],
+                                 r["S"], r["D"])
+                fresh = {key(r) for r in rows}
+                # re-measured keys REPLACE stale rows instead of duplicating
+                rows = [r for r in doc.get("rows", [])
+                        if key(r) not in fresh] + rows
+        doc["rows"] = rows
         with open(args.out, "w") as f:
-            json.dump({"peak_tflops": args.peak_tflops, "rows": rows}, f,
-                      indent=1)
-    print(json.dumps({"rows": len(rows)}))
+            json.dump(doc, f, indent=1)
+    print(json.dumps({"rows_measured": measured, "rows_total": len(rows)}))
 
 
 if __name__ == "__main__":

@@ -349,8 +349,8 @@ class Trainer:
                 if self.cfg.steps_per_epoch and n_batches >= self.cfg.steps_per_epoch:
                     break
         if n_batches:
-            count = max(sums.pop("count", 0.0), 1.0)
-            avg = {k.removesuffix("_sum"): v / count for k, v in sums.items()}
+            count = max(sums.get("count", 0.0), 1.0)
+            avg = metrics_lib.finalize_eval_sums(sums)
             log.info("eval epoch %d %s (n=%d)", epoch,
                      " ".join(f"{k} {v:.4f}" for k, v in avg.items()), int(count))
             self.metric_logger.write(kind="eval", epoch=epoch, count=count, **avg)

@@ -36,11 +36,20 @@ class SyntheticImageDataset:
     """
 
     def __init__(self, num_examples: int = 51200, image_size: int = 224,
-                 num_classes: int = 1000, seed: int = 0):
+                 num_classes: int = 1000, seed: int = 0,
+                 noise_seed: int | None = None, augment: bool = False):
         self.num_examples = num_examples
         self.image_size = image_size
         self.num_classes = num_classes
         self.seed = seed
+        # Per-sample noise stream. Class PATTERNS are keyed on `seed` so
+        # train and eval share the learnable signal, but a split built with
+        # a different `noise_seed` draws DISJOINT samples — a genuinely
+        # held-out set (the r4 artifact's eval indices reused the train
+        # noise stream, so "held-out" partially scored seen images).
+        self.noise_seed = seed if noise_seed is None else noise_seed
+        self.augment = augment
+        self.epoch = 0
         pat_rng = np.random.default_rng(seed + 12345)
         # Low-res patterns upsampled at access: O(classes * 8*8*3) memory.
         self._pat_res = min(8, image_size)
@@ -52,7 +61,7 @@ class SyntheticImageDataset:
         return self.num_examples
 
     def __getitem__(self, i: int):
-        rng = np.random.default_rng((self.seed, i))
+        rng = np.random.default_rng((self.noise_seed, i))
         label = np.int32(i % self.num_classes)
         img = rng.standard_normal(
             (self.image_size, self.image_size, 3), np.float32)
@@ -66,7 +75,18 @@ class SyntheticImageDataset:
                 (self.image_size, self.image_size, 3)).astype(np.float32)
             full[: img.shape[0], : img.shape[1]] = img
             img = full
-        return {"image": img.astype(np.float32), "label": label}
+        img = img.astype(np.float32)
+        if self.augment:
+            # CIFAR-style train transform (reflect-pad-4 crop + flip),
+            # reseeded per epoch like CIFAR10/FolderDataset.
+            arng = np.random.default_rng((self.noise_seed, self.epoch, i))
+            padded = np.pad(img, ((4, 4), (4, 4), (0, 0)), mode="reflect")
+            y, x = arng.integers(0, 9, size=2)
+            img = padded[y: y + self.image_size, x: x + self.image_size]
+            if arng.integers(0, 2):
+                img = img[:, ::-1]
+            img = np.ascontiguousarray(img)
+        return {"image": img, "label": label}
 
 
 class CIFAR10:
@@ -291,7 +311,13 @@ def build_dataset(name: str, data_path: str | None, train: bool, *,
     if name == "cifar10":
         if data_path and os.path.isdir(os.path.join(data_path, "cifar-10-batches-py")):
             return CIFAR10(data_path, train=train, seed=seed)
-        return SyntheticImageDataset(51200 if train else 10000, 32, 10, seed)
+        # Train split augments (CIFAR10-class parity); eval draws a
+        # DISJOINT noise stream — genuinely held-out samples of the same
+        # pattern distribution (see SyntheticImageDataset.noise_seed).
+        if train:
+            return SyntheticImageDataset(51200, 32, 10, seed, augment=True)
+        return SyntheticImageDataset(10000, 32, 10, seed,
+                                     noise_seed=seed + 777)
     if name in ("imagenet", "imagenet1k"):
         if data_path:
             split = os.path.join(data_path, "train" if train else "val")
@@ -324,7 +350,11 @@ def build_dataset(name: str, data_path: str | None, train: bool, *,
                     f"--data-path {data_path!r} does not exist")
             return FolderDataset(root, train=train, image_size=image_size,
                                  seed=seed)
-        return SyntheticImageDataset(1281167 if train else 50000, image_size, 1000, seed)
+        # perf vehicle (no augment), but eval still gets a disjoint
+        # noise stream so synthetic "val" never scores seen samples
+        return SyntheticImageDataset(
+            1281167 if train else 50000, image_size, 1000, seed,
+            noise_seed=seed if train else seed + 777)
     if name in ("lm", "synthetic_lm", "openwebtext"):
         if data_path and os.path.isfile(data_path):
             return TokenFileDataset(data_path, seq_len=seq_len)

@@ -102,6 +102,7 @@ class LlamaBlock(nn.Module):
     param_dtype: Any
     attn_impl: str = "auto"
     num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
+    moe_capacity_factor: float = 1.25
     sp: bool = False
 
     @nn.compact
@@ -117,7 +118,9 @@ class LlamaBlock(nn.Module):
         if self.num_experts > 0:
             from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
 
-            h = MoEBlock(self.num_experts, self.ffn_dim, dtype=self.dtype,
+            h = MoEBlock(self.num_experts, self.ffn_dim,
+                         capacity_factor=self.moe_capacity_factor,
+                         dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
         else:
             dense = lambda feat, name: nn.Dense(
@@ -147,6 +150,7 @@ class Llama(nn.Module):
     scan_layers: bool = False
     attn_impl: str = "auto"
     num_experts: int = 0
+    moe_capacity_factor: float = 1.25
     sp: bool = False
     logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
@@ -170,7 +174,8 @@ class Llama(nn.Module):
             head_dim=self.head_dim, ffn_dim=self.ffn_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
             param_dtype=self.param_dtype, attn_impl=self.attn_impl,
-            num_experts=self.num_experts, sp=self.sp)
+            num_experts=self.num_experts,
+            moe_capacity_factor=self.moe_capacity_factor, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts
@@ -247,11 +252,19 @@ def llama_moe_tiny(**kw) -> Llama:
     return llama_tiny(**kw)
 
 
-def llama_moe_400m(**kw) -> Llama:
-    """Bench-scale MoE Llama: the llama_400m backbone with its SwiGLU MLPs
-    replaced by 8-expert top-2 MoE blocks (~1.1B total params, ~400M-class
-    active compute per token) — the measured e2e EP row (BENCH_MOE.json)."""
+def llama_moe_520m(**kw) -> Llama:
+    """Bench-scale MoE Llama for the measured e2e EP row (BENCH_MOE.json):
+    the llama_400m trunk (d=1024, GQA 4:1, RoPE) at 12 layers with
+    8-expert top-2 MoE FFNs of ffn_dim 2048 — ~520M total / ~220M active
+    params. Sized so AdamW optimizer state (12 B/param f32) + bf16
+    compute copies + activations fit ONE v5e's 16 GB HBM: the 400m
+    backbone with 8 experts (1.18 B total) measured RESOURCE_EXHAUSTED
+    at any batch, with or without remat — expert stacks multiply FFN
+    params 8x, and optimizer memory, not activations, is the binding
+    constraint on a single chip (EP sharding divides it on real pods)."""
     kw.setdefault("num_experts", 8)
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("ffn_dim", 2048)
     return llama_400m(**kw)
 
 

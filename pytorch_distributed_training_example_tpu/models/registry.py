@@ -43,6 +43,7 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  seq_len: int = 1024, dtype=jnp.bfloat16, param_dtype=jnp.float32,
                  remat: bool = False, sp: bool = False,
                  attn_impl: str = "auto", dropout: float = 0.0,
+                 moe_capacity_factor: float = 1.25,
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
@@ -59,7 +60,8 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
     return builder(
         num_classes=num_classes, image_size=image_size, seq_len=seq_len,
         dtype=dtype, param_dtype=param_dtype, remat=remat, sp=sp,
-        attn_impl=attn_impl, dropout=dropout, logits_dtype=logits_dtype,
+        attn_impl=attn_impl, dropout=dropout,
+        moe_capacity_factor=moe_capacity_factor, logits_dtype=logits_dtype,
     )
 
 
@@ -190,14 +192,15 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False,
 
 @register("llama_moe")
 def _llama_moe(*, seq_len, dtype, param_dtype, remat, sp=False,
-               attn_impl="auto", logits_dtype, **_):
-    """Bench-scale MoE (llama_400m backbone, 8 experts top-2): the e2e EP
-    perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
+               attn_impl="auto", moe_capacity_factor=1.25, logits_dtype, **_):
+    """Bench-scale MoE (llama trunk, 8 experts top-2, ~520M total): the
+    e2e EP perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
     from pytorch_distributed_training_example_tpu.models import llama
 
-    module = llama.llama_moe_400m(dtype=dtype, param_dtype=param_dtype,
+    module = llama.llama_moe_520m(dtype=dtype, param_dtype=param_dtype,
                                   remat=remat, max_seq_len=max(seq_len, 2048),
                                   sp=sp, attn_impl=attn_impl,
+                                  moe_capacity_factor=moe_capacity_factor,
                                   logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)

@@ -100,6 +100,18 @@ PEAK_HBM_GBPS = {
 }
 
 
+def finalize_eval_sums(sums: dict) -> dict:
+    """Normalize accumulated eval-step outputs to per-example averages.
+
+    ``eval_step`` emits mask-weighted ``*_sum`` metrics plus a ``count``;
+    callers accumulate them across batches and call this once. Shared by
+    the trainer's evaluate loop and the convergence harness's
+    seen-samples probe so the key convention lives in one place.
+    """
+    count = max(sums.pop("count", 0.0), 1.0)
+    return {k.removesuffix("_sum"): v / count for k, v in sums.items()}
+
+
 def peak_hbm_gbps(device=None) -> float:
     if device is None:
         device = jax.devices()[0]
