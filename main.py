@@ -33,6 +33,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=None, dest="global_batch_size",
                    help="GLOBAL batch size (split across hosts/chips)")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["cosine", "step", "constant"],
+                   help="step = the reference ImageNet StepLR recipe "
+                        "(lr * gamma^(epoch // step-epochs))")
+    p.add_argument("--lr-step-epochs", type=int, default=None)
+    p.add_argument("--lr-gamma", type=float, default=None)
     p.add_argument("--weight-decay", type=float, default=None)
     p.add_argument("--optimizer", default=None, choices=["sgd", "adamw"])
     p.add_argument("--precision", default=None,

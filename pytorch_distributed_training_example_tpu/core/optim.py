@@ -16,12 +16,29 @@ from pytorch_distributed_training_example_tpu.utils.config import Config
 def build_schedule(cfg: Config, steps_per_epoch: int) -> optax.Schedule:
     total_steps = max(int(cfg.epochs * steps_per_epoch), 1)
     warmup_steps = min(int(cfg.warmup_epochs * steps_per_epoch), total_steps - 1)
+    if cfg.lr_schedule == "step":
+        # The reference ImageNet recipe (StepLR): lr * gamma^(epoch //
+        # step_epochs), evaluated on the GLOBAL step grid — decay epochs
+        # must not shift with warmup. join_schedules hands the post-warmup
+        # schedule (step - boundary), so shift it back by warmup_steps.
+        stair = optax.exponential_decay(
+            cfg.lr, transition_steps=max(cfg.lr_step_epochs, 1)
+            * steps_per_epoch, decay_rate=cfg.lr_gamma, staircase=True)
+        main = ((lambda step: stair(step + warmup_steps))
+                if warmup_steps > 0 else stair)
+    elif cfg.lr_schedule == "constant":
+        main = optax.constant_schedule(cfg.lr)
+    elif cfg.lr_schedule == "cosine":
+        main = optax.cosine_decay_schedule(
+            cfg.lr, decay_steps=max(total_steps - warmup_steps, 1))
+    else:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
+                         "(cosine | step | constant)")
     if warmup_steps > 0:
-        return optax.warmup_cosine_decay_schedule(
-            init_value=0.0, peak_value=cfg.lr,
-            warmup_steps=warmup_steps, decay_steps=total_steps,
-        )
-    return optax.cosine_decay_schedule(cfg.lr, decay_steps=total_steps)
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.lr, warmup_steps), main],
+            boundaries=[warmup_steps])
+    return main
 
 
 def build_optimizer(cfg: Config, steps_per_epoch: int):

@@ -25,6 +25,11 @@ class Config:
     global_batch_size: int = 256
     lr: float = 0.1
     warmup_epochs: float = 1.0
+    # cosine (default) | step (the reference ImageNet recipe:
+    # lr * gamma^(epoch // step_epochs)) | constant
+    lr_schedule: str = "cosine"
+    lr_step_epochs: int = 30
+    lr_gamma: float = 0.1
     weight_decay: float = 1e-4
     momentum: float = 0.9
     optimizer: str = "sgd"  # sgd | adamw

@@ -158,3 +158,48 @@ def test_metric_logger_tensorboard_step_axes(tmp_path):
     acc.Reload()
     assert [e.step for e in acc.Scalars("eval/loss")] == [99, 199]
     assert [e.step for e in acc.Scalars("train/loss")] == [99, 199]
+
+
+def test_lr_schedules_reference_recipes():
+    """Schedule parity: 'step' reproduces the reference ImageNet StepLR
+    (lr * gamma^(epoch // 30)); cosine + warmup keeps its r4 shape
+    (linear to peak at warmup end, cosine to 0 at the horizon);
+    'constant' is flat after warmup."""
+    from pytorch_distributed_training_example_tpu.core import optim
+    from pytorch_distributed_training_example_tpu.utils.config import Config
+
+    spe = 100
+    step = optim.build_schedule(
+        Config(lr=0.1, warmup_epochs=0.0, lr_schedule="step",
+               lr_step_epochs=30, lr_gamma=0.1, epochs=90), spe)
+    assert float(step(0)) == pytest.approx(0.1)
+    assert float(step(29 * spe + 99)) == pytest.approx(0.1)
+    assert float(step(30 * spe)) == pytest.approx(0.01)
+    assert float(step(60 * spe)) == pytest.approx(0.001)
+
+    # ...and the decay epochs stay on the GLOBAL grid under warmup: the
+    # reference recipe decays at epochs 30/60 regardless of warmup.
+    stepw = optim.build_schedule(
+        Config(lr=0.1, warmup_epochs=5.0, lr_schedule="step",
+               lr_step_epochs=30, lr_gamma=0.1, epochs=90), spe)
+    assert float(stepw(5 * spe // 2)) == pytest.approx(0.05)  # mid-warmup
+    assert float(stepw(29 * spe + 99)) == pytest.approx(0.1)
+    assert float(stepw(30 * spe)) == pytest.approx(0.01)
+    assert float(stepw(60 * spe)) == pytest.approx(0.001)
+
+    cos = optim.build_schedule(
+        Config(lr=0.4, warmup_epochs=1.0, lr_schedule="cosine", epochs=10),
+        spe)
+    assert float(cos(0)) == pytest.approx(0.0)
+    assert float(cos(spe)) == pytest.approx(0.4)       # peak at warmup end
+    assert float(cos(10 * spe)) == pytest.approx(0.0, abs=1e-6)
+    # halfway through the cosine phase = half the peak
+    assert float(cos(spe + (9 * spe) // 2)) == pytest.approx(0.2, rel=0.01)
+
+    const = optim.build_schedule(
+        Config(lr=0.05, warmup_epochs=0.0, lr_schedule="constant",
+               epochs=5), spe)
+    assert float(const(0)) == float(const(499)) == pytest.approx(0.05)
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        optim.build_schedule(Config(lr_schedule="nope"), spe)
