@@ -15,9 +15,9 @@ stay truly sequential (each consumes the previous state; per-step losses are
 returned so nothing dead-code-eliminates), while host dispatch overhead —
 measured ~75 ms/launch through the remote-tunnel TPU attachment used in CI
 (quantified by scan-length slope, BENCH_FLASH_MICRO.json) — is paid once
-instead of per step. The default 50 steps bounds that fixed cost to
-~1.5 ms/step of reported pessimism. This is the device-throughput number
-MFU is defined over.
+instead of per step. The default 200 steps bounds that fixed cost to
+~0.4 ms/step of reported pessimism (r5; 50 steps cost ViT-B/16 a full
+MFU point). This is the device-throughput number MFU is defined over.
 """
 
 from __future__ import annotations
@@ -91,7 +91,7 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
 
 
 def bench(model_name: str = "resnet50", image_size: int = 224,
-          per_chip_batch: int = 128, steps: int = 50, warmup: int = 10,
+          per_chip_batch: int = 128, steps: int = 200, warmup: int = 10,
           precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
           strategy: str | None = None, mesh_spec: dict | None = None,
           remat: bool = False, devices=None, attn_impl: str = "auto",
@@ -371,7 +371,9 @@ def main(argv=None):
     p.add_argument("--model", default="resnet50")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--per-chip-batch", type=int, default=128)
-    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--steps", type=int, default=200,
+                   help="scan length; long scans amortize the attachment's "
+                        "~75 ms fixed per-launch dispatch below 0.4 ms/step")
     p.add_argument("--warmup", type=int, default=10)
     p.add_argument("--precision", default="bf16")
     p.add_argument("--seq-len", type=int, default=1024)
@@ -428,7 +430,7 @@ def main(argv=None):
         if jax.default_backend() != "cpu":
             # per-chip batch 24: r4 sweep peak with the chunked-bwd flash
             # kernels (63.6% MFU vs 62.4% at the r3 batch of 16).
-            lm = bench("gpt2", per_chip_batch=24, steps=50, warmup=4,
+            lm = bench("gpt2", per_chip_batch=24, steps=200, warmup=4,
                        precision=args.precision, seq_len=1024, quiet=True)
             result["extra"]["lm"] = {
                 "metric": lm["metric"], "value": lm["value"],

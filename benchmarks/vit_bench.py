@@ -38,7 +38,7 @@ def main(argv=None):
     for b in [int(x) for x in args.batches.split(",")]:
         t0 = time.perf_counter()
         try:
-            r = bench("vit_b16", per_chip_batch=b, steps=30, warmup=4,
+            r = bench("vit_b16", per_chip_batch=b, steps=100, warmup=4,
                       precision="bf16", quiet=True)
             rows.append({"per_chip_batch": b, "value": r["value"],
                          "unit": r["unit"], "mfu": r["extra"]["mfu"],
