@@ -48,7 +48,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                seq_len: int = 1024, strategy: str | None = None,
                mesh_spec: dict | None = None, remat: bool = False,
                devices=None, attn_impl: str = "auto",
-               moe_capacity_factor: float = 1.25):
+               moe_capacity_factor: float = 1.25,
+               remat_policy: str = "nothing"):
     """Build (mesh, state, step_fn, device batch, bundle) exactly as the
     benchmark measures them — shared by bench() and benchmarks/profile_step.py
     so profiles describe the same program the headline numbers time."""
@@ -70,6 +71,7 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                                    image_size=image_size, seq_len=seq_len,
                                    dtype=policy.compute_dtype,
                                    param_dtype=policy.param_dtype, remat=remat,
+                                   remat_policy=remat_policy,
                                    attn_impl=attn_impl,
                                    moe_capacity_factor=moe_capacity_factor,
                                    logits_dtype=policy.logits_dtype)
@@ -95,7 +97,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
           strategy: str | None = None, mesh_spec: dict | None = None,
           remat: bool = False, devices=None, attn_impl: str = "auto",
-          moe_capacity_factor: float = 1.25):
+          moe_capacity_factor: float = 1.25, remat_policy: str = "nothing"):
     import jax
     import numpy as np
 
@@ -104,7 +106,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
 
     su = setup_step(model_name, image_size, per_chip_batch, precision, seq_len,
                     strategy, mesh_spec, remat, devices, attn_impl,
-                    moe_capacity_factor=moe_capacity_factor)
+                    moe_capacity_factor=moe_capacity_factor,
+                    remat_policy=remat_policy)
     mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
                                         su["batch"], su["bundle"])
     strategy, global_batch = su["strategy"], su["global_batch"]
@@ -195,6 +198,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             "precision": precision,
             "strategy": strategy,
             "attn_impl": attn_impl,
+            **({"remat_policy": remat_policy}
+               if remat_policy != "nothing" else {}),
             **({"roofline": roofline} if roofline else {}),
         },
     }
@@ -379,6 +384,10 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--strategy", default=None)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="nothing",
+                   choices=["nothing", "dots", "dots_no_batch", "attn_out"],
+                   help="checkpoint policy under --remat (Llama family): "
+                        "A/B the save-list for the backward recompute")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="MoE expert capacity factor (llama_moe rows)")
     p.add_argument("--attn-impl", default="auto",
@@ -401,7 +410,8 @@ def main(argv=None):
                    quiet=not args.verbose, seq_len=args.seq_len,
                    strategy=args.strategy, remat=args.remat,
                    attn_impl=args.attn_impl,
-                   moe_capacity_factor=args.moe_capacity_factor)
+                   moe_capacity_factor=args.moe_capacity_factor,
+                   remat_policy=args.remat_policy)
     if (args.model == "resnet50" and not args.no_measured_roofline):
         # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer
         # traffic from the scheduled HLO joined with xplane durations —

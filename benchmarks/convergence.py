@@ -132,9 +132,13 @@ def main(argv=None):
         # eval-split batches would otherwise pollute trained_indices()
         # with never-trained samples (r5 review finding). Toggle it
         # around the phases; all loaders here are consumed synchronously.
+        # try/finally so a raising train_epoch (OOM, fault injection)
+        # can't leak the env var into the eval phase or the next run.
         os.environ[loader_lib.INDEX_LOG_ENV] = idx_log
-        t.train_epoch(epoch)
-        os.environ.pop(loader_lib.INDEX_LOG_ENV, None)
+        try:
+            t.train_epoch(epoch)
+        finally:
+            os.environ.pop(loader_lib.INDEX_LOG_ENV, None)
         avg = t.evaluate(epoch)
         seen = eval_seen()
         row = {"epoch": epoch, "step": int(t.state.step),

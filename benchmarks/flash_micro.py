@@ -42,6 +42,14 @@ def main():
                         "invocations — with --merge, rows append into --out")
     p.add_argument("--merge", action="store_true",
                    help="append rows into an existing --out file")
+    p.add_argument("--block-sweep", action="store_true",
+                   help="sweep block_q x block_kv for the online kernel "
+                        "instead of comparing impls — the D=128 long-S "
+                        "tile-size search (PROFILE_LLAMA.md lever 1); rows "
+                        "carry block_q/block_kv and merge by that key")
+    p.add_argument("--blocks", default="256,512,1024",
+                   help="comma-separated candidate block sizes for "
+                        "--block-sweep (applied to both axes)")
     args = p.parse_args()
 
     import jax
@@ -115,12 +123,30 @@ def main():
         def stock(q, k, v, _scale=1.0 / math.sqrt(D)):
             return stock_fa(q, k, v, causal=True, sm_scale=_scale)
 
-        impls = [("oneshot", oneshot, (q, k, v)),
-                 ("online", online, (q, k, v)),
-                 ("xla", xla_attn, (q, k, v))]
-        if stock_fa is not None:
-            impls.append(("stock_jax_pallas", stock, (qh, kh, vh)))
-        for name, fn, (qi, ki, vi) in impls:
+        if args.block_sweep:
+            # Tile-size search for the online kernel only: the oneshot path
+            # picks its own plan and XLA has no block knob. Winning entries
+            # graduate into fa.ONLINE_BLOCK_TABLE.
+            cand = [int(x) for x in args.blocks.split(",")]
+            impls = []
+            for bq in cand:
+                for bkv in cand:
+                    if bq > S or bkv > S:
+                        continue
+
+                    def online_b(q, k, v, bq=bq, bkv=bkv):
+                        return fa.flash_attention(q, k, v, True, bq, bkv,
+                                                  "online")
+
+                    impls.append(("online", online_b, (q, k, v),
+                                  {"block_q": bq, "block_kv": bkv}))
+        else:
+            impls = [("oneshot", oneshot, (q, k, v), {}),
+                     ("online", online, (q, k, v), {}),
+                     ("xla", xla_attn, (q, k, v), {})]
+            if stock_fa is not None:
+                impls.append(("stock_jax_pallas", stock, (qh, kh, vh), {}))
+        for name, fn, (qi, ki, vi), tags in impls:
             ms_f = timed(fn, qi, ki, vi)
 
             def grad_step(qq, k, v, fn=fn):
@@ -139,7 +165,7 @@ def main():
                 fl = attn_flops(B, H, S, D, bwd=bwd)
                 tf = fl / (ms / 1e3) / 1e12
                 rows.append({"impl": name, "pass": tag, "B": B, "H": H,
-                             "S": S, "D": D, "ms": round(ms, 3),
+                             "S": S, "D": D, **tags, "ms": round(ms, 3),
                              "tflops": round(tf, 1),
                              "frac_peak": round(tf / args.peak_tflops, 3)})
                 print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
@@ -158,7 +184,8 @@ def main():
                         f"peak_tflops={doc['peak_tflops']}, this run to "
                         f"{args.peak_tflops}; frac_peak values would mix")
                 key = lambda r: (r["impl"], r["pass"], r["B"], r["H"],
-                                 r["S"], r["D"])
+                                 r["S"], r["D"], r.get("block_q"),
+                                 r.get("block_kv"))
                 fresh = {key(r) for r in rows}
                 # re-measured keys REPLACE stale rows instead of duplicating
                 rows = [r for r in doc.get("rows", [])

@@ -259,6 +259,7 @@ def collect_ops(trace_dir: str):
 
 def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             precision="bf16", seq_len=1024, strategy=None, remat=False,
+            remat_policy="nothing",
             attn_impl="auto", steps=3, trace_dir=None, top=25):
     import jax
 
@@ -269,6 +270,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
 
     su = setup_step(model_name, image_size, per_chip_batch, precision,
                     seq_len, strategy=strategy, remat=remat,
+                    remat_policy=remat_policy,
                     attn_impl=attn_impl)
     mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
     bundle = su["bundle"]
@@ -372,6 +374,8 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--strategy", default=None)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="nothing",
+                   choices=["nothing", "dots", "dots_no_batch", "attn_out"])
     p.add_argument("--attn-impl", default="auto")
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
@@ -380,7 +384,8 @@ def main(argv=None):
     res = profile(args.model, image_size=args.image_size,
                   per_chip_batch=args.per_chip_batch, precision=args.precision,
                   seq_len=args.seq_len, strategy=args.strategy,
-                  remat=args.remat, attn_impl=args.attn_impl,
+                  remat=args.remat, remat_policy=args.remat_policy,
+                  attn_impl=args.attn_impl,
                   steps=args.steps, top=args.top)
     if args.out:
         with open(args.out, "w") as f:

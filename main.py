@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-1 absorbs remaining devices)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="gradient checkpointing")
+    p.add_argument("--remat-policy", default=None, dest="remat_policy",
+                   choices=["nothing", "dots", "dots_no_batch", "attn_out"],
+                   help="checkpoint policy under --remat (Llama family): "
+                        "what to save across the backward recompute")
     p.add_argument("--grad-accum", type=int, default=None,
                    dest="grad_accum_steps",
                    help="gradient-accumulation microbatches per step")

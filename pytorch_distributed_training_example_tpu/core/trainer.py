@@ -55,6 +55,7 @@ class Trainer:
             cfg.model, num_classes=cfg.num_classes, image_size=cfg.image_size,
             seq_len=cfg.seq_len, dtype=self.policy.compute_dtype,
             param_dtype=self.policy.param_dtype, remat=cfg.remat,
+            remat_policy=cfg.remat_policy,
             sp=cfg.strategy.endswith("_sp"), attn_impl=cfg.attn_impl,
             dropout=cfg.dropout, logits_dtype=self.policy.logits_dtype)
 
@@ -224,6 +225,26 @@ class Trainer:
         # fast-forwarding the index stream is sample-exact.
         offset = int(extra.get("step_offset", self.steps_per_epoch))
         if offset < self.steps_per_epoch:
+            # Mid-epoch restore: the offset counts optimizer steps of the
+            # SAVING run's batch geometry. Resuming with a different
+            # --batch-size (or a loader that slices the epoch differently)
+            # would fast-forward to the wrong sample silently — refuse.
+            for key, current in (("global_batch_size",
+                                  self.cfg.global_batch_size),
+                                 ("steps_per_epoch", self.steps_per_epoch)):
+                recorded = extra.get(key)
+                if recorded is None:
+                    log.warning(
+                        "checkpoint predates %s recording; cannot verify "
+                        "the mid-epoch offset matches this run's batch "
+                        "geometry", key)
+                elif int(recorded) != current:
+                    raise ValueError(
+                        f"mid-epoch resume with mismatched {key}: checkpoint "
+                        f"was saved with {int(recorded)}, this run uses "
+                        f"{current}. The step offset {offset} would land on "
+                        "the wrong sample; resume with the original batch "
+                        "geometry or restart from an epoch boundary.")
             self.start_epoch = epoch
             self.start_step_offset = offset
             log.info("resumed from step %d (epoch %d, step offset %d)",
@@ -240,7 +261,13 @@ class Trainer:
         step = int(jax.device_get(self.state.step))
         if step == self._last_saved_step:
             return  # the step cadence already wrote this exact state
-        extra = {"epoch": epoch}
+        # Batch geometry travels with the checkpoint: a mid-epoch resume
+        # fast-forwards the sampler by step_offset * global_batch samples,
+        # which is only sample-exact if the restore run slices the epoch
+        # the same way (_resume validates).
+        extra = {"epoch": epoch,
+                 "global_batch_size": self.cfg.global_batch_size,
+                 "steps_per_epoch": self.steps_per_epoch}
         if step_offset is not None:
             extra["step_offset"] = step_offset
         self.checkpointer.save(self.state, step, extra=extra)

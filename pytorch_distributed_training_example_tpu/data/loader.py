@@ -23,7 +23,9 @@ from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
 # Debug/verification hook: when this env var names a file, every loader
 # appends one JSON line per YIELDED batch ({"epoch", "batch", "indices"}).
 # Used by the mid-epoch-resume test to assert sample-exact continuation
-# (no replay, no skip); per-process file — point each rank somewhere else.
+# (no replay, no skip). In multi-process runs every rank would otherwise
+# interleave appends into one file, so the path is suffixed ".rankN" when
+# jax reports more than one process.
 INDEX_LOG_ENV = "PDTX_INDEX_LOG"
 
 
@@ -31,6 +33,13 @@ def _log_indices(epoch: int, batch: int, indices) -> None:
     path = os.environ.get(INDEX_LOG_ENV)
     if not path:
         return
+    try:  # lazy: the loader is importable (and testable) without jax init
+        import jax
+
+        if jax.process_count() > 1:
+            path = f"{path}.rank{jax.process_index()}"
+    except ImportError:
+        pass
     with open(path, "a") as fh:
         fh.write(json.dumps({"epoch": int(epoch), "batch": int(batch),
                              "indices": [int(i) for i in indices]}) + "\n")

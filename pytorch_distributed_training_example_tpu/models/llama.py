@@ -20,6 +20,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import ad_checkpoint
 from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
@@ -87,6 +88,9 @@ class LlamaAttention(nn.Module):
         k = mesh_lib.constrain(k, P(BATCH, "context", "model", None))
         v = mesh_lib.constrain(v, P(BATCH, "context", "model", None))
         out = attn_lib.attention(q, k, v, causal=True, impl=self.attn_impl)
+        # Named for the "attn_out" remat policy (save attention outputs,
+        # recompute everything else): a no-op unless that policy is active.
+        out = ad_checkpoint.checkpoint_name(out, "attn_out")
         return nn.DenseGeneral(d, axis=(-2, -1), use_bias=False,
                                dtype=self.dtype, param_dtype=self.param_dtype,
                                name="out")(out)
@@ -102,6 +106,7 @@ class LlamaBlock(nn.Module):
     param_dtype: Any
     attn_impl: str = "auto"
     num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
+    moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     sp: bool = False
 
@@ -119,6 +124,7 @@ class LlamaBlock(nn.Module):
             from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
 
             h = MoEBlock(self.num_experts, self.ffn_dim,
+                         top_k=self.moe_top_k,
                          capacity_factor=self.moe_capacity_factor,
                          dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
@@ -135,6 +141,27 @@ class LlamaBlock(nn.Module):
         return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
 
 
+#: Remat policies for the grad-checkpoint config (selected by name so the
+#: flag threads through Config/argparse). "nothing" is the measured default
+#: (BENCH_LLAMA.json: rate-neutral at S=8192 b=1 vs no-remat, and the only
+#: policy that admits b=2). The alternatives trade activation memory for
+#: recompute FLOPs — A/B them with bench.py --remat-policy (see
+#: PROFILE_LLAMA.md lever 4):
+#:   nothing       recompute the whole block (minimum memory)
+#:   dots          save every matmul output (maximum saveable under remat)
+#:   dots_no_batch save matmul outputs with no batch dims (XLA's classic
+#:                 "save weights-only matmuls" heuristic)
+#:   attn_out      save only the attention outputs (tagged below): skips
+#:                 recomputing the S^2 attention in the backward at the cost
+#:                 of one [B,S,H,D] residual per layer
+REMAT_POLICIES = {
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
+}
+
+
 class Llama(nn.Module):
     vocab_size: int = 128256
     num_layers: int = 32
@@ -147,9 +174,11 @@ class Llama(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str = "nothing"  # key into REMAT_POLICIES
     scan_layers: bool = False
     attn_impl: str = "auto"
     num_experts: int = 0
+    moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     sp: bool = False
     logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
@@ -166,15 +195,19 @@ class Llama(nn.Module):
 
         block_cls = LlamaBlock
         if self.remat:
+            if self.remat_policy not in REMAT_POLICIES:
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r}; "
+                    f"have {sorted(REMAT_POLICIES)}")
             block_cls = nn.remat(
                 LlamaBlock, prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable)
+                policy=REMAT_POLICIES[self.remat_policy])
         block_args = dict(
             num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
             head_dim=self.head_dim, ffn_dim=self.ffn_dim,
             rope_theta=self.rope_theta, dtype=self.dtype,
             param_dtype=self.param_dtype, attn_impl=self.attn_impl,
-            num_experts=self.num_experts,
+            num_experts=self.num_experts, moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
@@ -281,12 +314,17 @@ def num_params(cfg: Llama) -> int:
     return V * d + L * (attn + mlp + 2 * d) + d + d * V
 
 
-def num_params_active(cfg: Llama, top_k: int = 2) -> int:
+def num_params_active(cfg: Llama, top_k: int | None = None) -> int:
     """Parameters touched per token — the honest FLOPs basis for MoE MFU
-    (6*N_active, PaLM-style): only the top_k routed experts' FFN weights
-    count, everything else as in the dense model."""
+    (6*N_active, PaLM-style): only the routed experts' FFN weights count,
+    everything else as in the dense model. ``top_k`` defaults to the
+    routing the model actually executes (``cfg.moe_top_k``) so the MFU
+    basis can't drift from the config (ADVICE r5)."""
     if not cfg.num_experts:
         return num_params(cfg)
+    if top_k is None:
+        top_k = cfg.moe_top_k
+    top_k = min(top_k, cfg.num_experts)
     total = num_params(cfg)
     per_expert = 2 * cfg.d_model * cfg.ffn_dim
     inactive = (cfg.num_experts - top_k) * per_expert * cfg.num_layers

@@ -41,7 +41,8 @@ def list_models() -> list[str]:
 
 def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  seq_len: int = 1024, dtype=jnp.bfloat16, param_dtype=jnp.float32,
-                 remat: bool = False, sp: bool = False,
+                 remat: bool = False, remat_policy: str = "nothing",
+                 sp: bool = False,
                  attn_impl: str = "auto", dropout: float = 0.0,
                  moe_capacity_factor: float = 1.25,
                  logits_dtype=jnp.float32) -> ModelBundle:
@@ -57,9 +58,18 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                 f"{dropout} would be silently ignored (the Llama and ResNet "
                 "families have no dropout knob, matching the reference "
                 "factories)")
+    if remat_policy != "nothing":
+        import inspect
+
+        if "remat_policy" not in inspect.signature(builder).parameters:
+            raise ValueError(
+                f"model {name!r} does not implement remat_policy; "
+                f"--remat-policy {remat_policy} would be silently ignored "
+                "(only the Llama family exposes checkpoint-policy tuning)")
     return builder(
         num_classes=num_classes, image_size=image_size, seq_len=seq_len,
-        dtype=dtype, param_dtype=param_dtype, remat=remat, sp=sp,
+        dtype=dtype, param_dtype=param_dtype, remat=remat,
+        remat_policy=remat_policy, sp=sp,
         attn_impl=attn_impl, dropout=dropout,
         moe_capacity_factor=moe_capacity_factor, logits_dtype=logits_dtype,
     )
@@ -143,46 +153,51 @@ def _gpt2_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto"
 
 
 @register("llama3_8b")
-def _llama3_8b(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
-               logits_dtype, **_):
+def _llama3_8b(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
+               sp=False, attn_impl="auto", logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama3_8b(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                             remat_policy=remat_policy,
                              max_seq_len=max(seq_len, 8192), sp=sp,
                              attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
 @register("llama_400m")
-def _llama_400m(*, seq_len, dtype, param_dtype, remat, sp=False,
-                attn_impl="auto", logits_dtype, **_):
+def _llama_400m(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
+                sp=False, attn_impl="auto", logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_400m(dtype=dtype, param_dtype=param_dtype,
-                              remat=remat, max_seq_len=max(seq_len, 2048),
+                              remat=remat, remat_policy=remat_policy,
+                              max_seq_len=max(seq_len, 2048),
                               sp=sp, attn_impl=attn_impl,
                               logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
 @register("llama_tiny")
-def _llama_tiny(*, seq_len, dtype, param_dtype, remat, sp=False, attn_impl="auto",
-                logits_dtype, **_):
+def _llama_tiny(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
+                sp=False, attn_impl="auto", logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_tiny(dtype=dtype, param_dtype=param_dtype, remat=remat,
+                              remat_policy=remat_policy,
                               max_seq_len=max(seq_len, 256), sp=sp,
                               attn_impl=attn_impl, logits_dtype=logits_dtype)
     return _lm_bundle(module, llama.TP_RULES, seq_len, llama.num_params)
 
 
 @register("llama_moe_tiny")
-def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False,
+def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
+                    remat_policy="nothing", sp=False,
                     attn_impl="auto", logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_moe_tiny(dtype=dtype, param_dtype=param_dtype,
-                                  remat=remat, max_seq_len=max(seq_len, 256),
+                                  remat=remat, remat_policy=remat_policy,
+                                  max_seq_len=max(seq_len, 256),
                                   sp=sp, attn_impl=attn_impl,
                                   logits_dtype=logits_dtype)
     # MFU basis = ACTIVE params (top-2 experts), not the full expert stack
@@ -191,14 +206,16 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat, sp=False,
 
 
 @register("llama_moe")
-def _llama_moe(*, seq_len, dtype, param_dtype, remat, sp=False,
+def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
+               sp=False,
                attn_impl="auto", moe_capacity_factor=1.25, logits_dtype, **_):
     """Bench-scale MoE (llama trunk, 8 experts top-2, ~520M total): the
     e2e EP perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_moe_520m(dtype=dtype, param_dtype=param_dtype,
-                                  remat=remat, max_seq_len=max(seq_len, 2048),
+                                  remat=remat, remat_policy=remat_policy,
+                                  max_seq_len=max(seq_len, 2048),
                                   sp=sp, attn_impl=attn_impl,
                                   moe_capacity_factor=moe_capacity_factor,
                                   logits_dtype=logits_dtype)

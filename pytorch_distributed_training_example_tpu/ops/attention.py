@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pytorch_distributed_training_example_tpu.ops import pallas_compat  # noqa: F401
+
 NEG_INF = -1e30
 
 

@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pytorch_distributed_training_example_tpu.ops import pallas_compat  # noqa: F401
 from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
 
 NEG_INF = -1e30
@@ -36,6 +38,26 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
 LSE_LANES = 8  # lse stored [B,H,S,8]: minor dims satisfy Mosaic tiling
+
+# Measured per-shape block overrides for the ONLINE kernels, keyed
+# (bwd, S, D) -> (block_q, block_kv). Consulted only when the caller left
+# block_q/block_kv at the module defaults (an explicit caller choice always
+# wins), so it is a tuning table, not an API change. Entries are added ONLY
+# from on-chip sweeps (``benchmarks/flash_micro.py --block-sweep`` emits the
+# grid); the r3 LM sweep that picked the 1024x1024 default ran at D=64 —
+# D=128 long-S shapes get their own rows here as they are measured.
+ONLINE_BLOCK_TABLE: dict[tuple[bool, int, int], tuple[int, int]] = {
+    # D=128, S=4096 fwd: default 1024x1024 measured 1.371 ms = 0.509 of MXU
+    # peak (BENCH_FLASH_MICRO.json r4) — the default IS the tuned choice.
+    (False, 4096, 128): (1024, 1024),
+}
+
+
+def _online_blocks(bwd: bool, s: int, d: int, block_q: int, block_kv: int):
+    """Resolve the online kernels' block sizes through ONLINE_BLOCK_TABLE."""
+    if (block_q, block_kv) != (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_KV):
+        return block_q, block_kv
+    return ONLINE_BLOCK_TABLE.get((bwd, s, d), (block_q, block_kv))
 
 
 def _fit_block(s: int, requested: int) -> int:
@@ -717,6 +739,168 @@ def _oneshot_bwd(q, k, v, o, lse, g, *, causal, plan, kv_len=None):
     return tr(dq), tr(dk), tr(dv)
 
 
+# ---------------------------------------------------------------------------
+# Streaming one-shot backward: the D=128 long-context path (ISSUE r6).
+#
+# At S >= 4096 with D=128 the dense one-shot backward no longer fits VMEM
+# (``_oneshot_plan(..., bwd=True)`` returns None) and dispatch fell back to
+# the two-kernel online backward. That path recomputes the score matrix
+# TWICE (dq pass + dkv pass): 7 S^2-scale matmuls and 2 full exp sweeps per
+# backward. This kernel does the whole backward in ONE pass — 5 matmuls,
+# 1 exp — by inverting the residency: each program pins one (batch,
+# head-group)'s full-Sq q/do/lse/delta plus an fp32 dq accumulator in VMEM
+# and STREAMS the kv axis on the innermost grid dimension. The kv dimension
+# is "arbitrary", so the Pallas pipeline double-buffers the k/v chunk
+# fetches against the compute of the previous chunk — the HBM->VMEM KV DMA
+# overlap the online kernels get per kv block, kept, while the score tile
+# is computed once. dk/dv for a chunk complete within its grid step (every
+# q subtile contributes in the unrolled loop); dq accumulates across chunks
+# and flushes on the last one. Causal chunk skipping is per q-subtile via
+# pl.when, same scheme as the chunked one-shot kernels.
+#
+# Auto-dispatch is gated to D=128 (this round's target; the D=64 dispatch
+# map is measured and unchanged) and can be widened or killed via
+# PDTX_STREAM_BWD ("all" = any head dim, "0" = off) until the on-chip A/B
+# lands.
+# ---------------------------------------------------------------------------
+
+STREAM_BWD = os.environ.get("PDTX_STREAM_BWD", "1")
+STREAM_BWD_BUDGET = 13 * 1024 * 1024  # same general-admission cap as one-shot
+
+
+def _stream_bwd_plan(H, Sq, Skv, D, *, mode=None):
+    """Pick (heads_per_program G, q_subtile_rows bsub, kv_chunk ck), or None.
+
+    Cost model (bytes live per program): resident q/do (bf16) + fp32 dq
+    accumulator + lse/delta rows, plus the double-buffered k/v chunk pair,
+    per-chunk dk/dv output blocks and fp32 accumulators, plus the transient
+    s/p/dp/ds tiles (14 B per (g, bsub, ck) cell, as in the one-shot bwd
+    model). None -> caller falls back to the online two-kernel backward.
+    """
+    mode = STREAM_BWD if mode is None else mode
+    if mode in ("0", "off"):
+        return None
+    if D != 128 and mode != "all":
+        return None
+    best = None
+    for g in range(min(H, 8), 0, -1):
+        if H % g:
+            continue
+        for bsub in (512, 256):
+            if bsub > Sq or Sq % bsub:
+                continue
+            ck = 512  # keeps per-chunk dots MXU-sized (see _oneshot_num_chunks)
+            if Skv % ck or Skv // ck < 2:
+                continue
+            resident = g * (2 * Sq * D * 2          # q + do (bf16)
+                            + Sq * D * 4            # dq accumulator (f32)
+                            + 2 * Sq * LSE_LANES * 4)  # lse + delta rows
+            chunk = g * ck * D * (2 * 2 * 2         # k/v, double-buffered
+                                  + 2 * 2           # dk/dv output blocks
+                                  + 2 * 4)          # dk/dv accumulators (f32)
+            tiles = 14 * g * bsub * ck              # s/p/dp f32 + ds bf16
+            if resident + chunk + tiles <= STREAM_BWD_BUDGET:
+                key = (g, bsub)
+                if best is None or key > best[0]:
+                    best = (key, (g, bsub, ck))
+    return best[1] if best else None
+
+
+def _stream_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, *,
+                       sm_scale, causal, bsub, num_sub):
+    c = pl.program_id(2)
+    n_c = pl.num_programs(2)
+    ck = k_ref.shape[2]
+
+    @pl.when(c == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    # dk/dv complete within this grid step — reset every chunk.
+    dk_acc[:] = jnp.zeros_like(dk_acc)
+    dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k_c = _mxu(k_ref[0])                          # [G, ck, D]
+    v_c = _mxu(v_ref[0])
+    for qs in range(num_sub):
+        visible = True
+        if causal:
+            # Subtile qs sees chunk c iff any of its rows reach the chunk's
+            # first key; fully-above-diagonal (subtile, chunk) pairs skip
+            # the dots AND the exp entirely.
+            visible = c * ck < (qs + 1) * bsub
+
+        @pl.when(visible)
+        def _sub(qs=qs):
+            lo = qs * bsub
+            q_s = _mxu(q_ref[0, :, lo:lo + bsub, :])      # [G, bsub, D]
+            do_s = _mxu(do_ref[0, :, lo:lo + bsub, :])
+            lse_s = lse_ref[0, :, lo:lo + bsub, :1]       # [G, bsub, 1]
+            delta_s = delta_ref[0, :, lo:lo + bsub, :1]
+            s = jax.lax.dot_general(q_s, k_c, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = s * sm_scale
+            if causal:
+                s = _causal_mask_chunk(s, qs, bsub, c * ck)
+            p = jnp.exp(s - lse_s)                        # [G, bsub, ck]
+            dv_acc[:] += jax.lax.dot_general(
+                p.astype(do_s.dtype), do_s, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do_s, v_c, (((2,), (2,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta_s) * sm_scale).astype(k_c.dtype)
+            dq_acc[:, lo:lo + bsub, :] += jax.lax.dot_general(
+                ds, k_c, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            dk_acc[:] += jax.lax.dot_general(
+                ds, q_s, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    @pl.when(c == n_c - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _stream_bwd(q, k, v, o, lse, g, *, causal, plan):
+    """q,k,v,o,g: [B,S,H,D] (kv already GQA-expanded); lse: [B,H,Sq,LANES]."""
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    G, bsub, ck = plan
+    sm_scale = 1.0 / math.sqrt(D)
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LSE_LANES))
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+    qspec = pl.BlockSpec((1, G, Sq, D), lambda b, h, c: (b, h, 0, 0))
+    cspec = pl.BlockSpec((1, G, ck, D), lambda b, h, c: (b, h, c, 0))
+    lspec = pl.BlockSpec((1, G, Sq, LSE_LANES), lambda b, h, c: (b, h, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_stream_bwd_kernel, sm_scale=sm_scale,
+                          causal=causal, bsub=bsub, num_sub=Sq // bsub),
+        grid=(B, H // G, Skv // ck),
+        in_specs=[qspec, cspec, cspec, qspec, lspec, lspec],
+        out_specs=(qspec, cspec, cspec),
+        out_shape=(jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((G, Sq, D), jnp.float32),   # dq
+                        pltpu.VMEM((G, ck, D), jnp.float32),   # dk
+                        pltpu.VMEM((G, ck, D), jnp.float32)],  # dv
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt, dot, lse, delta)
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
@@ -779,6 +963,7 @@ def _fwd_dispatch(q, k, v, causal, block_q, block_kv, impl, kv_len):
                             if kv_len is not None else ""))
     if plan is not None:
         return _oneshot_fwd(q, k, v, causal=causal, plan=plan, kv_len=kv_len)
+    block_q, block_kv = _online_blocks(False, Sq, D, block_q, block_kv)
     return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                       block_kv=block_kv)
 
@@ -817,8 +1002,20 @@ def _vjp_bwd(causal, block_q, block_kv, impl, kv_len, res, g):
         dq, dk, dv = _oneshot_bwd(q, ke, ve, o, lse, g, causal=causal,
                                   plan=plan, kv_len=kv_len)
     else:
-        dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
-                                block_q=block_q, block_kv=block_kv)
+        # Long-S fallback order: the streaming one-pass backward where its
+        # plan fits (D=128 gate — see _stream_bwd_plan), else the online
+        # two-kernel backward.
+        splan = None
+        if impl == "auto" and kv_len is None:
+            splan = _stream_bwd_plan(H, q.shape[1], ke.shape[1], q.shape[3])
+        if splan is not None:
+            dq, dk, dv = _stream_bwd(q, ke, ve, o, lse, g, causal=causal,
+                                     plan=splan)
+        else:
+            block_q, block_kv = _online_blocks(True, q.shape[1], q.shape[3],
+                                               block_q, block_kv)
+            dq, dk, dv = _flash_bwd(q, ke, ve, o, lse, g, causal=causal,
+                                    block_q=block_q, block_kv=block_kv)
     if Hkv != H:
         # GQA: fold the repeated-head grads back onto the shared KV heads.
         B, S, _, D = dk.shape

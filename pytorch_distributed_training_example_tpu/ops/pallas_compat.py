@@ -1,0 +1,61 @@
+"""Version compat for the jax/Pallas API surface the kernels use.
+
+The code targets the current API (``pltpu.CompilerParams``,
+``pltpu.force_tpu_interpret_mode``, ``jax.shard_map``). jax 0.4.x spells
+these ``TPUCompilerParams``, nothing at all, and
+``jax.experimental.shard_map.shard_map(check_rep=...)`` — which made every
+kernel call site *and* every interpret-mode CPU test fail on 0.4.x hosts.
+Importing this module (ops.flash_attention, ops.attention,
+parallel.pipeline and tests/conftest all do) patches the names in place,
+so call sites stay written against the modern API:
+
+- ``pltpu.CompilerParams``: aliased to ``TPUCompilerParams`` when missing.
+- ``pltpu.force_tpu_interpret_mode``: emulated by wrapping
+  ``pl.pallas_call`` with ``interpret=True`` for the duration of the
+  context. Like the real thing, it takes effect at trace time, so
+  ``jit``/``grad`` regions traced inside the context run the kernels in
+  interpret mode.
+- ``jax.shard_map``: forwarded to ``jax.experimental.shard_map.shard_map``
+  with ``check_vma`` translated to the old ``check_rep``.
+
+No-op on jax versions that already provide the modern names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True,
+                          **kwargs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kwargs)
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(pltpu, "CompilerParams") and hasattr(pltpu, "TPUCompilerParams"):
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+if not hasattr(pltpu, "force_tpu_interpret_mode"):
+
+    @contextlib.contextmanager
+    def force_tpu_interpret_mode():
+        orig = pl.pallas_call
+
+        def _interpreted(*args, **kwargs):
+            kwargs.setdefault("interpret", True)
+            return orig(*args, **kwargs)
+
+        pl.pallas_call = _interpreted
+        try:
+            yield
+        finally:
+            pl.pallas_call = orig
+
+    pltpu.force_tpu_interpret_mode = force_tpu_interpret_mode

@@ -43,6 +43,9 @@ class Config:
     # precision / memory
     precision: str = "bf16"
     remat: bool = False  # gradient checkpointing (reference configs[4])
+    # checkpoint policy under remat (Llama family): nothing | dots |
+    # dots_no_batch | attn_out — see models.llama.REMAT_POLICIES
+    remat_policy: str = "nothing"
     grad_accum_steps: int = 1  # microbatches per optimizer step (in-step scan)
     pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)

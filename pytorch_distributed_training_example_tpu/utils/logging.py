@@ -74,7 +74,12 @@ class MetricLogger:
                 step = int(metrics["epoch"])
             else:
                 step = prev + 1
-            self._steps[kind] = max(prev, step)
+            # Clamp to the per-kind high-water mark so a resume that replays
+            # an earlier step (or an epoch row computed from a shorter
+            # steps_per_epoch) cannot emit a backwards x-value — TensorBoard
+            # renders non-monotonic series as a sawtooth.
+            step = max(prev, step)
+            self._steps[kind] = step
             for key, val in metrics.items():
                 if key in ("kind", "step", "time"):
                     continue

@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# jax 0.4.x API-compat patches (CompilerParams name, interpret-mode context)
+# must land before any test module imports pallas symbols.
+from pytorch_distributed_training_example_tpu.ops import pallas_compat  # noqa: E402,F401
+
 jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: XLA:CPU compiles dominate suite wall time
 # (25s -> ~7s for a ResNet-18 train step on re-runs). Machine-local cache in

@@ -343,7 +343,9 @@ def test_lowp_probs_residual_softmax():
 def test_oneshot_plan_dispatch_thresholds():
     """Lock in the measured auto-dispatch map (BENCH_FLASH_MICRO.json r4):
     causal forwards stream (online), backwards go one-shot whenever the
-    plan fits VMEM, long-context backwards fall back to online."""
+    plan fits VMEM; long-context backwards leave the dense plan (the
+    fallback is streaming at D=128, online elsewhere — see
+    test_auto_dispatch_is_per_direction)."""
     # GPT-2 / Llama-class shapes: the one-shot backward plan exists
     assert F._oneshot_plan(12, 1024, 1024, 64, bwd=True) is not None
     assert F._oneshot_plan(16, 2048, 2048, 128, bwd=True) is not None
@@ -370,8 +372,10 @@ def test_auto_dispatch_is_per_direction(monkeypatch):
     """The measured r4 dispatch map must hold structurally: causal auto
     forwards stream (online), non-causal auto forwards take one-shot when
     a plan exists, and auto backwards take one-shot whenever the bwd plan
-    fits, falling back to online at long context. Kernels are stubbed so
-    this asserts the routing, not the math (covered elsewhere)."""
+    fits. Long-context backwards fall back to the streaming one-pass
+    backward at D=128 (r6) and to the online kernel pair elsewhere.
+    Kernels are stubbed so this asserts the routing, not the math
+    (covered elsewhere)."""
     calls = []
     monkeypatch.setattr(F, "_flash_fwd",
                         lambda *a, **k: (calls.append("online_fwd"), ("o", "l"))[1])
@@ -381,16 +385,104 @@ def test_auto_dispatch_is_per_direction(monkeypatch):
                         lambda *a, **k: (calls.append("online_bwd"), ("q", "k", "v"))[1])
     monkeypatch.setattr(F, "_oneshot_bwd",
                         lambda *a, **k: (calls.append("oneshot_bwd"), ("q", "k", "v"))[1])
+    monkeypatch.setattr(F, "_stream_bwd",
+                        lambda *a, **k: (calls.append("stream_bwd"), ("q", "k", "v"))[1])
     q = jnp.zeros((1, 1024, 12, 64), jnp.bfloat16)
     F._fwd_dispatch(q, q, q, True, 1024, 1024, "auto", None)
     F._fwd_dispatch(q, q, q, False, 1024, 1024, "auto", None)
     res = (q, q, q, "o", "l")
     F._vjp_bwd(True, 1024, 1024, "auto", None, res, jnp.zeros_like(q))
-    q4 = jnp.zeros((1, 4096, 16, 64), jnp.bfloat16)  # bwd plan infeasible
+    q4 = jnp.zeros((1, 4096, 16, 64), jnp.bfloat16)  # bwd plan infeasible, D=64
     F._vjp_bwd(True, 1024, 1024, "auto", None, (q4, q4, q4, "o", "l"),
                jnp.zeros_like(q4))
+    q8 = jnp.zeros((1, 8192, 16, 128), jnp.bfloat16)  # D=128 long context
+    F._vjp_bwd(True, 1024, 1024, "auto", None, (q8, q8, q8, "o", "l"),
+               jnp.zeros_like(q8))
+    # forced online must never take the streaming path
+    F._vjp_bwd(True, 1024, 1024, "online", None, (q8, q8, q8, "o", "l"),
+               jnp.zeros_like(q8))
     assert calls == ["online_fwd", "oneshot_fwd", "oneshot_bwd",
-                     "online_bwd"], calls
+                     "online_bwd", "stream_bwd", "online_bwd"], calls
+
+
+def test_stream_bwd_plan_thresholds():
+    """Lock the streaming-backward admission map (r6): engages only where
+    the dense one-shot bwd plan is infeasible AND D=128 (the dedicated
+    long-context round; PDTX_STREAM_BWD="all" widens, "0" kills)."""
+    # the S=8192 contract shape: full-Sq residency fits at (G=1, bsub=256)
+    assert F._stream_bwd_plan(16, 8192, 8192, 128) == (1, 256, 512)
+    # S=4096/D=128 (bwd one-shot infeasible there too): fatter subtiles fit
+    assert F._stream_bwd_plan(16, 4096, 4096, 128) == (1, 512, 512)
+    # D=64 keeps the measured online fallback unless widened explicitly
+    assert F._stream_bwd_plan(16, 8192, 8192, 64) is None
+    assert F._stream_bwd_plan(16, 8192, 8192, 64, mode="all") == (1, 512, 512)
+    # kill switch
+    assert F._stream_bwd_plan(16, 8192, 8192, 128, mode="0") is None
+    # sub-chunk sequences have nothing to stream
+    assert F._stream_bwd_plan(16, 512, 512, 128) is None
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_stream_bwd_parity_d128_interpret(causal):
+    """D=128 streaming one-pass backward vs the oracle VJP at S=2048
+    (direct call: at this S auto dispatch still picks the dense one-shot
+    bwd, but the kernel must be exact wherever its plan admits).
+    Tolerances match the D=64 chunked-bwd assertions."""
+    q, k, v = _qkv(B=1, S=2048, H=2, D=128)
+    plan = F._stream_bwd_plan(2, 2048, 2048, 128)
+    assert plan is not None
+    g = jnp.asarray(np.random.RandomState(1).randn(*q.shape), jnp.float32)
+    ref, vjp = jax.vjp(
+        lambda *a: A.dot_product_attention(*a, causal=causal), q, k, v)
+    g_ref = vjp(g)
+    with pltpu.force_tpu_interpret_mode():
+        out, lse = F._flash_fwd(q, k, v, causal=causal,
+                                block_q=512, block_kv=512)
+        g_out = F._stream_bwd(q, k, v, out, lse, g, causal=causal, plan=plan)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow  # interpret-mode S=8192: minutes on the CPU CI host
+@pytest.mark.parametrize("causal", [False, True])
+def test_stream_bwd_parity_s8192_interpret(causal):
+    """The exact contract shape's (S=8192, D=128) plan, end to end."""
+    q, k, v = _qkv(B=1, S=8192, H=1, D=128)
+    plan = F._stream_bwd_plan(1, 8192, 8192, 128)
+    assert plan == (1, 256, 512)
+    g = jnp.asarray(np.random.RandomState(1).randn(*q.shape), jnp.float32)
+    ref, vjp = jax.vjp(
+        lambda *a: A.dot_product_attention(*a, causal=causal), q, k, v)
+    g_ref = vjp(g)
+    with pltpu.force_tpu_interpret_mode():
+        out, lse = F._flash_fwd(q, k, v, causal=causal,
+                                block_q=1024, block_kv=1024)
+        g_out = F._stream_bwd(q, k, v, out, lse, g, causal=causal, plan=plan)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_stream_bwd_auto_path_gqa_grads_interpret(monkeypatch):
+    """End to end through flash_attention's custom VJP: when the one-shot
+    bwd plan is infeasible and the streaming plan admits, auto grads route
+    through the streaming backward — including the GQA head fold."""
+    monkeypatch.setattr(F, "_oneshot_plan", lambda *a, **k: None)
+    monkeypatch.setattr(F, "STREAM_BWD", "all")  # small-D test shape
+    q, k, v = _qkv(B=1, S=1024, H=4, Hkv=2, D=16)
+    assert F._stream_bwd_plan(4, 1024, 1024, 16) is not None
+    g_ref = jax.grad(lambda *a: A.dot_product_attention(*a, causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    with pltpu.force_tpu_interpret_mode():
+        g_out = jax.grad(
+            lambda *a: F.flash_attention(*a, True, 512, 512, "auto").sum(),
+            argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_padded_flash_eligibility_gates():
