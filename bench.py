@@ -49,6 +49,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                mesh_spec: dict | None = None, remat: bool = False,
                devices=None, attn_impl: str = "auto",
                moe_capacity_factor: float = 1.25,
+               moe_top_k: int = 2, moe_dispatch_impl: str = "gather",
+               moe_combine_dtype: str = "fp32",
                remat_policy: str = "nothing"):
     """Build (mesh, state, step_fn, device batch, bundle) exactly as the
     benchmark measures them — shared by bench() and benchmarks/profile_step.py
@@ -74,6 +76,9 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                                    remat_policy=remat_policy,
                                    attn_impl=attn_impl,
                                    moe_capacity_factor=moe_capacity_factor,
+                                   moe_top_k=moe_top_k,
+                                   moe_dispatch_impl=moe_dispatch_impl,
+                                   moe_combine_dtype=moe_combine_dtype,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -97,7 +102,9 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           precision: str = "bf16", quiet: bool = True, seq_len: int = 1024,
           strategy: str | None = None, mesh_spec: dict | None = None,
           remat: bool = False, devices=None, attn_impl: str = "auto",
-          moe_capacity_factor: float = 1.25, remat_policy: str = "nothing"):
+          moe_capacity_factor: float = 1.25, moe_top_k: int = 2,
+          moe_dispatch_impl: str = "gather", moe_combine_dtype: str = "fp32",
+          remat_policy: str = "nothing"):
     import jax
     import numpy as np
 
@@ -107,6 +114,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
     su = setup_step(model_name, image_size, per_chip_batch, precision, seq_len,
                     strategy, mesh_spec, remat, devices, attn_impl,
                     moe_capacity_factor=moe_capacity_factor,
+                    moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
+                    moe_combine_dtype=moe_combine_dtype,
                     remat_policy=remat_policy)
     mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
                                         su["batch"], su["bundle"])
@@ -198,6 +207,11 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             "precision": precision,
             "strategy": strategy,
             "attn_impl": attn_impl,
+            **({"moe_dispatch_impl": moe_dispatch_impl,
+                "moe_top_k": moe_top_k,
+                "moe_combine_dtype": moe_combine_dtype,
+                "moe_capacity_factor": moe_capacity_factor}
+               if "moe" in model_name else {}),
             **({"remat_policy": remat_policy}
                if remat_policy != "nothing" else {}),
             **({"roofline": roofline} if roofline else {}),
@@ -388,6 +402,13 @@ def main(argv=None):
                    choices=["nothing", "dots", "dots_no_batch", "attn_out"],
                    help="checkpoint policy under --remat (Llama family): "
                         "A/B the save-list for the backward recompute")
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="experts routed per token (llama_moe family)")
+    p.add_argument("--moe-dispatch", default="gather",
+                   choices=["sort", "gather", "einsum"], dest="moe_dispatch",
+                   help="MoE dispatch formulation (parallel/moe.py)")
+    p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"],
+                   help="combine-einsum precision (router stays fp32)")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="MoE expert capacity factor (llama_moe rows)")
     p.add_argument("--attn-impl", default="auto",
@@ -411,6 +432,9 @@ def main(argv=None):
                    strategy=args.strategy, remat=args.remat,
                    attn_impl=args.attn_impl,
                    moe_capacity_factor=args.moe_capacity_factor,
+                   moe_top_k=args.moe_top_k,
+                   moe_dispatch_impl=args.moe_dispatch,
+                   moe_combine_dtype=args.moe_combine,
                    remat_policy=args.remat_policy)
     if (args.model == "resnet50" and not args.no_measured_roofline):
         # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer

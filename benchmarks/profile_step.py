@@ -114,6 +114,59 @@ def build_op_categories(hlo_text: str):
     return op_cat, op_src
 
 
+# MoE step regions tagged with jax.named_scope in parallel/moe.py. The tag
+# survives into op_name metadata for forward ops ("...moe_dispatch/...") and
+# for their cotangents (jax keeps the scope path inside transpose(...)), so
+# a rollup by tag attributes fwd+bwd time per region.
+_MOE_TAG_RE = re.compile(r"\bmoe_(router|dispatch|experts|combine|aux)\b")
+
+
+def _moe_tag(line: str) -> str | None:
+    m = re.search(r'op_name="([^"]+)"', line)
+    if not m:
+        return None
+    t = _MOE_TAG_RE.search(m.group(1))
+    return f"moe_{t.group(1)}" if t else None
+
+
+def build_op_moe_tags(hlo_text: str):
+    """Map instruction name -> MoE step region (moe_router / moe_dispatch /
+    moe_experts / moe_combine / moe_aux) from the named-scope tags in
+    op_name metadata. A fusion is attributed to the tag the majority of its
+    fused instructions carry (mixed fusions happen at region boundaries);
+    untagged instructions are absent from the map."""
+    comp_bodies = {}
+    for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?:\.clone)? \([^)]*\) -> .*? \{\n(.*?)^\}",
+                         hlo_text, re.M | re.S):
+        comp_bodies[m.group(1)] = m.group(2)
+    comp_tags: dict[str, collections.Counter] = {}
+    for name, body in comp_bodies.items():
+        c = collections.Counter()
+        for line in body.splitlines():
+            t = _moe_tag(line)
+            if t:
+                c[t] += 1
+        comp_tags[name] = c
+
+    op_moe = {}
+    for name, body in comp_bodies.items():
+        for line in body.splitlines():
+            im = re.match(
+                r"\s+(?:ROOT )?%?([\w.\-]+) = .*?([a-z][a-z0-9\-]*)\(", line)
+            if not im:
+                continue
+            op, opcode = im.group(1), im.group(2)
+            tag = _moe_tag(line)
+            if opcode == "fusion":
+                cm = re.search(r"calls=%?([\w.\-]+)", line)
+                cnt = comp_tags.get(cm.group(1)) if cm else None
+                if cnt:
+                    tag = cnt.most_common(1)[0][0]
+            if tag:
+                op_moe[op] = tag
+    return op_moe
+
+
 _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
                 "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
                 "f64": 8, "f8e4m3fn": 1, "f8e5m2": 1}
@@ -260,7 +313,9 @@ def collect_ops(trace_dir: str):
 def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             precision="bf16", seq_len=1024, strategy=None, remat=False,
             remat_policy="nothing",
-            attn_impl="auto", steps=3, trace_dir=None, top=25):
+            attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
+            moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+            steps=3, trace_dir=None, top=25):
     import jax
 
     from bench import setup_step
@@ -271,7 +326,11 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
     su = setup_step(model_name, image_size, per_chip_batch, precision,
                     seq_len, strategy=strategy, remat=remat,
                     remat_policy=remat_policy,
-                    attn_impl=attn_impl)
+                    attn_impl=attn_impl,
+                    moe_capacity_factor=moe_capacity_factor,
+                    moe_top_k=moe_top_k,
+                    moe_dispatch_impl=moe_dispatch_impl,
+                    moe_combine_dtype=moe_combine_dtype)
     mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
     bundle = su["bundle"]
     trace_dir = trace_dir or tempfile.mkdtemp(prefix="xprof_")
@@ -280,6 +339,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
         hlo_text = compiled.as_text()
         op_cat, op_src = build_op_categories(hlo_text)
         op_bytes = build_op_bytes(hlo_text)
+        op_moe = build_op_moe_tags(hlo_text)
         state, m = compiled(state, batch)  # warm
         jax.tree.map(lambda x: x.block_until_ready(), m)
         jax.profiler.start_trace(trace_dir)
@@ -291,6 +351,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
     ops, module_ns, module_runs = collect_ops(trace_dir)
     n_steps = module_runs or steps
     cats = collections.defaultdict(lambda: [0.0, 0, 0])  # ns, count, bytes
+    moe_cats = collections.defaultdict(lambda: [0.0, 0, 0])
     rows = []
     total_ns = 0.0
     unmatched_ns = 0.0
@@ -306,11 +367,16 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
         cats[cat][0] += ns
         cats[cat][1] += count
         cats[cat][2] += b
+        moe = op_moe.get(op, "non_moe")
+        moe_cats[moe][0] += ns
+        moe_cats[moe][1] += count
+        moe_cats[moe][2] += b
         total_ns += ns
         traffic_bytes += b
         op_ms = ns / n_steps / 1e6
         rows.append({"ms_per_step": op_ms,
                      "count": count // n_steps, "category": cat,
+                     "moe_region": op_moe.get(op),
                      "gbytes": round(b / 1e9, 3),
                      "gbps": round(b / (op_ms * 1e6), 1) if op_ms else 0.0,
                      "src": op_src.get(op), "hlo": name[:300]})
@@ -327,6 +393,20 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
           "achieved_gbps": round(b * n_steps / ns, 1) if ns else 0.0}
          for c, (ns, n, b) in cats.items()),
         key=lambda r: -r["ms_per_step"])
+
+    # MoE region rollup (router / dispatch / experts / combine / aux, fwd +
+    # bwd): present only when the lowered module carries moe named-scope
+    # tags — the per-category table behind PROFILE_MOE.md.
+    moe_rows = None
+    if len(moe_cats) > 1 or "non_moe" not in moe_cats:
+        moe_rows = sorted(
+            ({"region": c, "ms_per_step": round(ns / n_steps / 1e6, 3),
+              "pct": round(100 * ns / total_ns, 2),
+              "ops_per_step": n // n_steps,
+              "gbytes_per_step": round(b / 1e9, 3),
+              "achieved_gbps": round(b * n_steps / ns, 1) if ns else 0.0}
+             for c, (ns, n, b) in moe_cats.items()),
+            key=lambda r: -r["ms_per_step"])
 
     step_ms = total_ns / n_steps / 1e6
     flops = bundle.fwd_flops_per_example * 3 * per_chip_batch
@@ -358,11 +438,133 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
         "roofline_measured": roofline,
         "categories": [{**r, "ms_per_step": round(r["ms_per_step"], 2),
                         "pct": round(r["pct"], 1)} for r in cat_rows],
+        **({"moe_regions": moe_rows,
+            "moe_dispatch_impl": moe_dispatch_impl,
+            "moe_top_k": moe_top_k,
+            "moe_combine_dtype": moe_combine_dtype,
+            "moe_capacity_factor": moe_capacity_factor}
+           if moe_rows else {}),
         "top_ops": [{**r, "ms_per_step": round(r["ms_per_step"], 3)}
                     for r in rows[:top]],
         "trace_dir": trace_dir,
     }
     return out
+
+
+def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
+               seq_len=2048, strategy=None, remat=False,
+               remat_policy="nothing", attn_impl="auto",
+               moe_capacity_factor=1.0, moe_top_k=2,
+               moe_dispatch_impl="gather", moe_combine_dtype="fp32"):
+    """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
+
+    AOT-lowers the SAME train step bench.py times — same registry model,
+    optimizer, strategy resolution as ``bench.setup_step`` — but with
+    ABSTRACT inputs (``jax.eval_shape``; no params materialized), then
+    classifies every instruction of the compiled module by its moe
+    named-scope tag and tabulates static program facts per region: op
+    counts, modeled HBM bytes (``build_op_bytes``), and the HLO category
+    mix. No timing. The fusion/schedule is THIS process' XLA backend (on a
+    CPU host: XLA:CPU) — op counts and logical bytes are facts of the
+    lowered program, but TPU fusion differs, so downstream consumers must
+    label these numbers derived, not measured."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib, optim, precision as precision_lib, train_loop)
+    from pytorch_distributed_training_example_tpu.core.train_state import (
+        TrainState)
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.parallel import (
+        sharding as sharding_lib)
+    from pytorch_distributed_training_example_tpu.utils.config import (
+        from_preset)
+
+    mesh = mesh_lib.build_mesh({"data": -1})
+    global_batch = per_chip_batch * mesh_lib.dp_size(mesh)
+    cfg = from_preset("resnet50_imagenet", global_batch_size=global_batch,
+                      precision=precision)
+    strategy = strategy or ("fsdp" if "llama" in model_name
+                            or "gpt" in model_name else cfg.strategy)
+    policy = precision_lib.get_policy(cfg.precision)
+    bundle = registry.create_model(model_name, seq_len=seq_len,
+                                   dtype=policy.compute_dtype,
+                                   param_dtype=policy.param_dtype,
+                                   remat=remat, remat_policy=remat_policy,
+                                   attn_impl=attn_impl,
+                                   moe_capacity_factor=moe_capacity_factor,
+                                   moe_top_k=moe_top_k,
+                                   moe_dispatch_impl=moe_dispatch_impl,
+                                   moe_combine_dtype=moe_combine_dtype,
+                                   logits_dtype=policy.logits_dtype)
+    tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
+    rules = sharding_lib.strategy_rules(strategy, bundle.rules)
+    module = bundle.module
+
+    def init_fn(rng):
+        variables = module.init({"params": rng}, *jax.tree.map(
+            lambda t: t[:1], bundle.input_template), train=False)
+        return TrainState.create(apply_fn=module.apply,
+                                 params=variables["params"], tx=tx,
+                                 rng=jax.random.PRNGKey(0))
+
+    state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = train_loop.state_shardings(state_shape, mesh, rules)
+    abstract_state = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state_shape, shardings)
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    abstract_batch = {
+        "tokens": jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32,
+                                       sharding=batch_sh),
+        "targets": jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32,
+                                        sharding=batch_sh),
+    }
+    step = jax.jit(train_loop.make_train_step(
+        train_loop.get_task(bundle.task)), donate_argnums=0)
+    with mesh_lib.use_mesh(mesh):
+        compiled = step.lower(abstract_state, abstract_batch).compile()
+    hlo_text = compiled.as_text()
+    op_cat, _ = build_op_categories(hlo_text)
+    op_bytes = build_op_bytes(hlo_text)
+    op_moe = build_op_moe_tags(hlo_text)
+
+    regions: dict[str, dict] = {}
+    for op, b in op_bytes.items():
+        tag = op_moe.get(op, "non_moe")
+        row = regions.setdefault(tag, {"ops": 0, "gbytes_modeled": 0.0,
+                                       "by_category": collections.Counter()})
+        row["ops"] += 1
+        row["gbytes_modeled"] += b / 1e9
+        if b or op_cat.get(op) not in (None, "copy_layout"):
+            row["by_category"][op_cat.get(op, "?")] += 1
+    for row in regions.values():
+        row["gbytes_modeled"] = round(row["gbytes_modeled"], 3)
+        row["by_category"] = dict(row["by_category"].most_common(6))
+    try:
+        ca = compiled.cost_analysis() or {}
+    except Exception:
+        ca = {}
+    if isinstance(ca, list):  # older jax: one dict per program
+        ca = ca[0] if ca else {}
+    return {
+        "mode": "aot_hlo_model",
+        "backend_lowering": jax.default_backend(),
+        "model": model_name,
+        "per_chip_batch": per_chip_batch,
+        "seq_len": seq_len,
+        "precision": precision,
+        "strategy": strategy,
+        "moe_dispatch_impl": moe_dispatch_impl,
+        "moe_top_k": moe_top_k,
+        "moe_combine_dtype": moe_combine_dtype,
+        "moe_capacity_factor": moe_capacity_factor,
+        "xla_flops_per_step": ca.get("flops"),
+        "xla_bytes_accessed": ca.get("bytes accessed"),
+        "regions": dict(sorted(regions.items(),
+                               key=lambda kv: -kv[1]["gbytes_modeled"])),
+    }
 
 
 def main(argv=None):
@@ -377,15 +579,43 @@ def main(argv=None):
     p.add_argument("--remat-policy", default="nothing",
                    choices=["nothing", "dots", "dots_no_batch", "attn_out"])
     p.add_argument("--attn-impl", default="auto")
+    p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-dispatch", default="gather",
+                   choices=["sort", "gather", "einsum"])
+    p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"])
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25)
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
+    p.add_argument("--aot", action="store_true",
+                   help="no-chip mode: AOT-lower with abstract inputs and "
+                        "report static per-moe-region program facts "
+                        "(modeled bytes/op counts) instead of traced times")
     p.add_argument("--out", default=None, help="write full JSON here")
     args = p.parse_args(argv)
+    if args.aot:
+        res = aot_report(args.model, per_chip_batch=args.per_chip_batch,
+                         precision=args.precision, seq_len=args.seq_len,
+                         strategy=args.strategy, remat=args.remat,
+                         remat_policy=args.remat_policy,
+                         attn_impl=args.attn_impl,
+                         moe_capacity_factor=args.moe_capacity_factor,
+                         moe_top_k=args.moe_top_k,
+                         moe_dispatch_impl=args.moe_dispatch,
+                         moe_combine_dtype=args.moe_combine)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(res, f, indent=1)
+        print(json.dumps(res))
+        return 0
     res = profile(args.model, image_size=args.image_size,
                   per_chip_batch=args.per_chip_batch, precision=args.precision,
                   seq_len=args.seq_len, strategy=args.strategy,
                   remat=args.remat, remat_policy=args.remat_policy,
                   attn_impl=args.attn_impl,
+                  moe_capacity_factor=args.moe_capacity_factor,
+                  moe_top_k=args.moe_top_k,
+                  moe_dispatch_impl=args.moe_dispatch,
+                  moe_combine_dtype=args.moe_combine,
                   steps=args.steps, top=args.top)
     if args.out:
         with open(args.out, "w") as f:
@@ -395,6 +625,8 @@ def main(argv=None):
                                 "unmatched_pct")}
     slim["roofline_measured"] = res["roofline_measured"]
     for c in res["categories"]:
+        print(json.dumps(c), file=sys.stderr)
+    for c in res.get("moe_regions") or []:
         print(json.dumps(c), file=sys.stderr)
     print(json.dumps(slim))
     return 0

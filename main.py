@@ -63,6 +63,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attention kernel: Pallas flash, ring (context-"
                         "parallel), Ulysses all-to-all, or plain XLA")
     p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--moe-top-k", type=int, default=None, dest="moe_top_k",
+                   help="experts routed per token (llama_moe family)")
+    p.add_argument("--moe-capacity-factor", type=float, default=None,
+                   dest="moe_capacity_factor",
+                   help="expert capacity = cf * T * top_k / E (tokens beyond "
+                        "it are dropped, Switch-style)")
+    p.add_argument("--moe-dispatch", default=None, dest="moe_dispatch_impl",
+                   choices=["sort", "gather", "einsum"],
+                   help="MoE token-dispatch formulation (parallel/moe.py): "
+                        "sort (argsort+segment), gather (slot table), or "
+                        "einsum (one-hot masks, GSPMD oracle)")
+    p.add_argument("--moe-combine", default=None, dest="moe_combine_dtype",
+                   choices=["fp32", "bf16"],
+                   help="combine-einsum precision (bf16 halves combine "
+                        "bandwidth; router always fp32)")
     p.add_argument("--dropout", type=float, default=None,
                    help="model dropout rate (families that support it)")
     p.add_argument("--tensorboard-dir", type=str, default=None,
@@ -132,6 +147,14 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", platform)
+
+    # Sharding-invariant RNG: jax 0.4.x defaults threefry_partitionable to
+    # False, where a param initialized under a sharded mesh draws DIFFERENT
+    # bits than the same seed on one device — checkpoints and loss curves
+    # would then depend on topology. True is the jax 0.5+ default.
+    import jax
+
+    jax.config.update("jax_threefry_partitionable", True)
 
     # Persistent compile cache: repeat invocations (dev loops, restarts,
     # --resume) skip XLA recompilation. Opt out / relocate via env.

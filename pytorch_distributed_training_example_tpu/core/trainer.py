@@ -57,7 +57,12 @@ class Trainer:
             param_dtype=self.policy.param_dtype, remat=cfg.remat,
             remat_policy=cfg.remat_policy,
             sp=cfg.strategy.endswith("_sp"), attn_impl=cfg.attn_impl,
-            dropout=cfg.dropout, logits_dtype=self.policy.logits_dtype)
+            dropout=cfg.dropout,
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_top_k=cfg.moe_top_k,
+            moe_dispatch_impl=cfg.moe_dispatch_impl,
+            moe_combine_dtype=cfg.moe_combine_dtype,
+            logits_dtype=self.policy.logits_dtype)
 
         # data ------------------------------------------------------------
         vocab = getattr(self.bundle.module, "vocab_size", 50257)

@@ -108,6 +108,8 @@ class LlamaBlock(nn.Module):
     num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch_impl: str = "gather"  # sort | gather | einsum (parallel/moe.py)
+    moe_combine_dtype: Any = None      # None -> fp32 combine (exact)
     sp: bool = False
 
     @nn.compact
@@ -126,6 +128,8 @@ class LlamaBlock(nn.Module):
             h = MoEBlock(self.num_experts, self.ffn_dim,
                          top_k=self.moe_top_k,
                          capacity_factor=self.moe_capacity_factor,
+                         dispatch_impl=self.moe_dispatch_impl,
+                         combine_dtype=self.moe_combine_dtype,
                          dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
         else:
@@ -180,6 +184,8 @@ class Llama(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch_impl: str = "gather"
+    moe_combine_dtype: Any = None
     sp: bool = False
     logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
@@ -208,7 +214,9 @@ class Llama(nn.Module):
             rope_theta=self.rope_theta, dtype=self.dtype,
             param_dtype=self.param_dtype, attn_impl=self.attn_impl,
             num_experts=self.num_experts, moe_top_k=self.moe_top_k,
-            moe_capacity_factor=self.moe_capacity_factor, sp=self.sp)
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_dispatch_impl=self.moe_dispatch_impl,
+            moe_combine_dtype=self.moe_combine_dtype, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts

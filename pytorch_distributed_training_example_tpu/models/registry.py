@@ -45,6 +45,9 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  sp: bool = False,
                  attn_impl: str = "auto", dropout: float = 0.0,
                  moe_capacity_factor: float = 1.25,
+                 moe_top_k: int = 2,
+                 moe_dispatch_impl: str = "gather",
+                 moe_combine_dtype: str = "fp32",
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
@@ -71,8 +74,26 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
         dtype=dtype, param_dtype=param_dtype, remat=remat,
         remat_policy=remat_policy, sp=sp,
         attn_impl=attn_impl, dropout=dropout,
-        moe_capacity_factor=moe_capacity_factor, logits_dtype=logits_dtype,
+        moe_capacity_factor=moe_capacity_factor,
+        moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
+        moe_combine_dtype=moe_combine_dtype, logits_dtype=logits_dtype,
     )
+
+
+# --moe-combine flag values -> MoEBlock.combine_dtype (None = fp32, exact)
+_MOE_COMBINE_DTYPES = {"fp32": None, "bf16": jnp.bfloat16}
+
+
+def _moe_kwargs(moe_capacity_factor, moe_top_k, moe_dispatch_impl,
+                moe_combine_dtype):
+    if moe_combine_dtype not in _MOE_COMBINE_DTYPES:
+        raise ValueError(
+            f"unknown moe_combine_dtype {moe_combine_dtype!r}; "
+            f"have {sorted(_MOE_COMBINE_DTYPES)}")
+    return dict(moe_capacity_factor=moe_capacity_factor,
+                moe_top_k=moe_top_k,
+                moe_dispatch_impl=moe_dispatch_impl,
+                moe_combine_dtype=_MOE_COMBINE_DTYPES[moe_combine_dtype])
 
 
 @register("vit_b16")
@@ -192,14 +213,19 @@ def _llama_tiny(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
 @register("llama_moe_tiny")
 def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
                     remat_policy="nothing", sp=False,
-                    attn_impl="auto", logits_dtype, **_):
+                    attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
+                    moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+                    logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
     module = llama.llama_moe_tiny(dtype=dtype, param_dtype=param_dtype,
                                   remat=remat, remat_policy=remat_policy,
                                   max_seq_len=max(seq_len, 256),
                                   sp=sp, attn_impl=attn_impl,
-                                  logits_dtype=logits_dtype)
+                                  logits_dtype=logits_dtype,
+                                  **_moe_kwargs(moe_capacity_factor, moe_top_k,
+                                                moe_dispatch_impl,
+                                                moe_combine_dtype))
     # MFU basis = ACTIVE params (top-2 experts), not the full expert stack
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
@@ -208,7 +234,9 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
 @register("llama_moe")
 def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                sp=False,
-               attn_impl="auto", moe_capacity_factor=1.25, logits_dtype, **_):
+               attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
+               moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+               logits_dtype, **_):
     """Bench-scale MoE (llama trunk, 8 experts top-2, ~520M total): the
     e2e EP perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
     from pytorch_distributed_training_example_tpu.models import llama
@@ -217,8 +245,10 @@ def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                                   remat=remat, remat_policy=remat_policy,
                                   max_seq_len=max(seq_len, 2048),
                                   sp=sp, attn_impl=attn_impl,
-                                  moe_capacity_factor=moe_capacity_factor,
-                                  logits_dtype=logits_dtype)
+                                  logits_dtype=logits_dtype,
+                                  **_moe_kwargs(moe_capacity_factor, moe_top_k,
+                                                moe_dispatch_impl,
+                                                moe_combine_dtype))
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
 

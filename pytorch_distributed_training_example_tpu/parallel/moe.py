@@ -1,15 +1,22 @@
 """Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
 
 SURVEY.md §2c "EP": Switch/GShard-style token routing, built the GSPMD way —
-dispatch/combine are einsums against a capacity-bucketed one-hot mask, with
-expert-stacked FFN weights sharded on ``expert``; XLA partitions the einsums
-and inserts the token all-to-all automatically (no hand-written routing
-transport).
+expert-stacked FFN weights sharded on ``expert``; XLA partitions the expert
+einsums and inserts the token all-to-all automatically (no hand-written
+routing transport).
 
 Top-k gating (k=1 Switch, k=2 GShard defaults), capacity factor with token
 dropping, and the standard load-balancing auxiliary loss (mean(gates)*
 fraction-routed per expert, scaled by E), surfaced via the flax ``sow``
 mechanism under the ``"losses"`` collection as ``moe_aux_loss``.
+
+Three dispatch implementations share identical routing/drop semantics (the
+priority order is: earlier tokens first, k=0 choices before k=1) and are
+equivalence-tested against each other — see ``dispatch_impl`` on
+``MoEBlock``. The step regions are tagged with ``jax.named_scope`` (
+``moe_router`` / ``moe_dispatch`` / ``moe_experts`` / ``moe_combine`` /
+``moe_aux``) so ``benchmarks/profile_step.py`` can attribute device time
+per region from an xplane trace (PROFILE_MOE.md).
 """
 
 from __future__ import annotations
@@ -52,15 +59,32 @@ class ExpertFFN(nn.Module):
 class MoEBlock(nn.Module):
     """Router + expert FFNs; drop-in replacement for a dense MLP block.
 
-    Two dispatch implementations, equivalence-tested against each other:
+    Dispatch implementations, equivalence-tested against each other:
 
-    - ``"gather"`` (default): scatter token ids into an ``[E*C]`` slot table,
-      gather token vectors into ``[E, C, d]``, gather expert outputs back by
-      slot. Memory O(E*C*d + T*k) — scales to real token counts.
+    - ``"sort"`` (recommended; MegaBlocks-style reformulation): stable-argsort
+      the (token, choice) pairs by expert id, recover per-expert segment
+      offsets from the sorted order, and take the first ``capacity`` entries
+      of each expert's contiguous run as the ``[E, C, d]`` dispatch. Index
+      work is O(T·k log T·k) sort + O(T·k) segment arithmetic — no
+      ``[T, k, E]`` one-hot mask, no ``k·T × E`` cumsum, no ``E·C``-slot
+      scatter. Same capacity-overflow drop semantics (stable sort preserves
+      the priority order within each expert queue).
+    - ``"gather"``: scatter token ids into an ``[E*C]`` slot table, gather
+      token vectors into ``[E, C, d]``, gather expert outputs back by slot.
+      Computes queue positions via a ``[k·T, E]`` one-hot cumsum. Memory
+      O(E*C*d + T*k); index work O(T·k·E).
     - ``"einsum"``: the GShard/Switch formulation with an explicit
       ``[T, E, C]`` dispatch/combine mask. O(T*E*C) memory; kept because its
       einsums partition very predictably under GSPMD (useful oracle and
       fallback).
+
+    ``combine_dtype`` sets the precision of the output combine (the
+    slot-gather of expert outputs + the ``tk,tkd->td`` gate einsum). It
+    defaults to fp32 — the historical behavior and the equivalence oracle.
+    The combine is pure bandwidth (its FLOPs are negligible; the gather of
+    ``[T, k, d]`` expert outputs dominates), so running it in bf16 halves
+    its HBM traffic; accumulation stays fp32 via
+    ``preferred_element_type``. Router logits/softmax/top-k are always fp32.
     """
 
     num_experts: int
@@ -69,9 +93,10 @@ class MoEBlock(nn.Module):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 1e-3
-    dispatch_impl: str = "gather"  # "gather" | "einsum"
+    dispatch_impl: str = "gather"  # "sort" | "gather" | "einsum"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    combine_dtype: Any = None  # None -> fp32 (exact); bf16 halves combine BW
 
     @nn.compact
     def __call__(self, x, train: bool = True):  # x: [B, S, d]
@@ -82,92 +107,184 @@ class MoEBlock(nn.Module):
         capacity = max(int(self.capacity_factor * T * self.top_k / E), 1)
 
         # Router in fp32 (standard for stability).
-        router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
-                                 param_dtype=jnp.float32,
-                                 name="router")(tokens.astype(jnp.float32))
-        probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
+        with jax.named_scope("moe_router"):
+            router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                                     param_dtype=jnp.float32,
+                                     name="router")(tokens.astype(jnp.float32))
+            probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
 
-        # Top-k expert choice per token.
-        gate_vals, expert_idx = jax.lax.top_k(probs, self.top_k)  # [T, k]
-        gate_vals = gate_vals / jnp.maximum(
-            gate_vals.sum(-1, keepdims=True), 1e-9)
+            # Top-k expert choice per token.
+            gate_vals, expert_idx = jax.lax.top_k(probs, self.top_k)  # [T, k]
+            gate_vals = gate_vals / jnp.maximum(
+                gate_vals.sum(-1, keepdims=True), 1e-9)
 
-        # Capacity bucketing: position of each token within its expert queue.
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, k, E]
-        # priority: earlier tokens first, k=0 choices before k=1
-        flat = onehot.transpose(1, 0, 2).reshape(self.top_k * T, E)
-        pos_in_expert = jnp.cumsum(flat, axis=0) - flat            # [kT, E]
-        pos = (pos_in_expert.reshape(self.top_k, T, E)
-               .transpose(1, 0, 2) * onehot).sum(-1)               # [T, k]
-        within_cap = pos < capacity
-        gate_vals = gate_vals * within_cap
-
-        if self.dispatch_impl == "einsum":
-            out = self._einsum_route(tokens, onehot, pos, within_cap,
-                                     gate_vals, capacity)
+        if self.dispatch_impl == "sort":
+            out = self._sort_route(tokens, expert_idx, gate_vals, capacity)
         else:
-            out = self._gather_route(tokens, expert_idx, pos, within_cap,
-                                     gate_vals, capacity)
+            with jax.named_scope("moe_dispatch"):
+                # Capacity bucketing: position of each token within its
+                # expert queue, via the [k·T, E] one-hot cumsum.
+                onehot = jax.nn.one_hot(expert_idx, E,
+                                        dtype=jnp.float32)  # [T, k, E]
+                # priority: earlier tokens first, k=0 choices before k=1
+                flat = onehot.transpose(1, 0, 2).reshape(self.top_k * T, E)
+                pos_in_expert = jnp.cumsum(flat, axis=0) - flat     # [kT, E]
+                pos = (pos_in_expert.reshape(self.top_k, T, E)
+                       .transpose(1, 0, 2) * onehot).sum(-1)        # [T, k]
+                within_cap = pos < capacity
+                gate_vals = gate_vals * within_cap
 
-        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
-        me = probs.mean(0)                                # mean router prob
-        ce = onehot[:, 0].mean(0)                         # top-1 routed frac
-        aux = E * jnp.sum(me * ce)
-        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
-        # Router z-loss (ST-MoE): keeps logits from drifting to magnitudes
-        # where fp32 softmax saturates.
-        z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
-        self.sow("losses", "moe_z_loss", self.z_loss_weight * z)
+            if self.dispatch_impl == "einsum":
+                out = self._einsum_route(tokens, onehot, pos, within_cap,
+                                         gate_vals, capacity)
+            else:
+                out = self._gather_route(tokens, expert_idx, pos, within_cap,
+                                         gate_vals, capacity)
+
+        with jax.named_scope("moe_aux"):
+            # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+            me = probs.mean(0)                            # mean router prob
+            ce = jax.nn.one_hot(expert_idx[:, 0], E,
+                                dtype=jnp.float32).mean(0)  # top-1 routed frac
+            aux = E * jnp.sum(me * ce)
+            self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
+            # Router z-loss (ST-MoE): keeps logits from drifting to
+            # magnitudes where fp32 softmax saturates.
+            z = jnp.mean(
+                jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+            self.sow("losses", "moe_z_loss", self.z_loss_weight * z)
 
         return out.reshape(B, S, d).astype(self.dtype)
 
     def _experts(self, dispatched):
-        dispatched = mesh_lib.constrain(dispatched, P("expert", None, None))
-        expert_out = ExpertFFN(self.num_experts, self.ffn_dim, self.dtype,
-                               self.param_dtype, name="experts")(dispatched)
-        return mesh_lib.constrain(expert_out, P("expert", None, None))
+        with jax.named_scope("moe_experts"):
+            dispatched = mesh_lib.constrain(dispatched, P("expert", None, None))
+            expert_out = ExpertFFN(self.num_experts, self.ffn_dim, self.dtype,
+                                   self.param_dtype, name="experts")(dispatched)
+            return mesh_lib.constrain(expert_out, P("expert", None, None))
+
+    def _combine(self, expert_out, slot, gate_vals, n_slots):
+        """Gather expert outputs back by slot and mix by gate weight.
+
+        [E, C, d] expert outputs -> [T, k, d] gather by slot (the trash row
+        n_slots reads zeros for dropped tokens) -> gate-weighted sum over k.
+        Runs in ``combine_dtype`` (fp32 default); the einsum accumulates in
+        fp32 either way via preferred_element_type.
+        """
+        with jax.named_scope("moe_combine"):
+            d = expert_out.shape[-1]
+            cdt = self.combine_dtype or jnp.float32
+            out_pad = jnp.concatenate(
+                [expert_out.reshape(n_slots, d).astype(cdt),
+                 jnp.zeros((1, d), cdt)])                       # trash row
+            # Replicate the slot table before the combine gather. Every
+            # token needs rows from every expert, so GSPMD must all-gather
+            # the [E·C, d] outputs over 'expert' here regardless; making it
+            # explicit also sidesteps a jax 0.4.x SPMD partitioner
+            # miscompile for gathers with sharded operands (wrong values,
+            # reproduced in tests/test_moe_sort_dispatch.py's EP suite).
+            out_pad = mesh_lib.constrain(out_pad, P(None, None))
+            y = out_pad[slot]                                   # [T, k, d]
+            return jnp.einsum("tk,tkd->td", gate_vals.astype(cdt), y,
+                              preferred_element_type=jnp.float32)
+
+    def _sort_route(self, tokens, expert_idx, gate_vals, capacity):
+        """Sort-based dispatch (MegaBlocks-style, capacity-dropped).
+
+        Flattens the (choice, token) pairs in the legacy priority order
+        (index j = k_idx*T + t: all k=0 choices for tokens 0..T-1, then
+        k=1), stable-argsorts by expert id, and reads per-expert queues as
+        contiguous runs of the sorted order. Stable sort preserves the
+        priority order within each expert, so the within-queue position —
+        rank in sorted order minus the expert's segment start — equals the
+        one-hot-cumsum position of the gather/einsum paths exactly, drop
+        for drop.
+        """
+        T, d = tokens.shape
+        E = self.num_experts
+        k = self.top_k
+        n_slots = E * capacity
+        with jax.named_scope("moe_dispatch"):
+            e_flat = expert_idx.T.reshape(-1).astype(jnp.int32)     # [kT]
+            order = jnp.argsort(e_flat, stable=True)                # [kT]
+            sorted_e = e_flat[order]
+            counts = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+            # Routing index vectors are O(E) and O(k·T) ints — pin them
+            # replicated so sharding propagation (backward from the
+            # expert-sharded dispatch) can never turn `starts[sorted_e]`
+            # into a sharded-operand gather (miscompiled by the jax 0.4.x
+            # SPMD partitioner; see _combine).
+            counts = mesh_lib.constrain(counts, P(None))
+            starts = mesh_lib.constrain(starts, P(None))
+            pos_sorted = (jnp.arange(k * T, dtype=jnp.int32)
+                          - starts[sorted_e])
+            # Invert the permutation to per-(token, choice) positions.
+            pos_flat = jnp.zeros((k * T,), jnp.int32).at[order].set(
+                pos_sorted, unique_indices=True)
+            pos = pos_flat.reshape(k, T).T                          # [T, k]
+            within_cap = pos < capacity
+            gate_vals = gate_vals * within_cap
+
+            # Expert e's queue = sorted entries [starts[e], starts[e]+C):
+            # one [E, C] take of token rows — no E*C scatter, no [T,k,E]
+            # mask. Overflow entries (c >= counts[e]) read the zero row T.
+            tok_flat = (order % T).astype(jnp.int32)
+            take = starts[:, None] + jnp.arange(capacity,
+                                                dtype=jnp.int32)[None, :]
+            valid = jnp.arange(capacity)[None, :] < counts[:, None]  # [E, C]
+            tok_for_slot = jnp.where(
+                valid, tok_flat[jnp.minimum(take, k * T - 1)], T)
+            tokens_pad = jnp.concatenate(
+                [tokens, jnp.zeros((1, d), tokens.dtype)])          # row T = 0
+            dispatched = tokens_pad[tok_for_slot].astype(self.dtype)
+        expert_out = self._experts(dispatched)
+        slot = jnp.where(within_cap,
+                         expert_idx * capacity + pos, n_slots)      # [T, k]
+        return self._combine(expert_out, slot, gate_vals, n_slots)
 
     def _gather_route(self, tokens, expert_idx, pos, within_cap, gate_vals,
                       capacity):
         T, d = tokens.shape
         E = self.num_experts
         n_slots = E * capacity
-        # Each kept (token, choice) owns one slot; the trash row (index
-        # n_slots) absorbs dropped tokens. Slots are unique per expert queue
-        # position, so the scatter has no collisions.
-        slot = jnp.where(within_cap,
-                         expert_idx * capacity + pos.astype(jnp.int32),
-                         n_slots)                                   # [T, k]
-        tok_ids = jnp.broadcast_to(
-            jnp.arange(T, dtype=jnp.int32)[:, None], slot.shape)
-        token_for_slot = jnp.full((n_slots + 1,), T, jnp.int32)
-        token_for_slot = token_for_slot.at[slot.reshape(-1)].set(
-            tok_ids.reshape(-1))
-        tokens_pad = jnp.concatenate(
-            [tokens, jnp.zeros((1, d), tokens.dtype)])              # row T = 0
-        dispatched = tokens_pad[token_for_slot[:n_slots]].reshape(
-            E, capacity, d).astype(self.dtype)
+        with jax.named_scope("moe_dispatch"):
+            # Each kept (token, choice) owns one slot; the trash row (index
+            # n_slots) absorbs dropped tokens. Slots are unique per expert
+            # queue position, so the scatter has no collisions.
+            slot = jnp.where(within_cap,
+                             expert_idx * capacity + pos.astype(jnp.int32),
+                             n_slots)                               # [T, k]
+            tok_ids = jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[:, None], slot.shape)
+            token_for_slot = jnp.full((n_slots + 1,), T, jnp.int32)
+            token_for_slot = token_for_slot.at[slot.reshape(-1)].set(
+                tok_ids.reshape(-1))
+            tokens_pad = jnp.concatenate(
+                [tokens, jnp.zeros((1, d), tokens.dtype)])          # row T = 0
+            dispatched = tokens_pad[token_for_slot[:n_slots]].reshape(
+                E, capacity, d).astype(self.dtype)
         expert_out = self._experts(dispatched)
-        out_pad = jnp.concatenate(
-            [expert_out.reshape(n_slots, d).astype(jnp.float32),
-             jnp.zeros((1, d), jnp.float32)])                       # trash row
-        y = out_pad[slot]                                           # [T, k, d]
-        return jnp.einsum("tk,tkd->td", gate_vals, y)
+        return self._combine(expert_out, slot, gate_vals, n_slots)
 
     def _einsum_route(self, tokens, onehot, pos, within_cap, gate_vals,
                       capacity):
-        # Dispatch mask [T, k, E, C] -> combined [T, E, C].
-        cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                    dtype=jnp.float32)  # [T,k,C]
-        dispatch = jnp.einsum("tke,tkc->tec", onehot,
-                              cap_onehot * within_cap[..., None])
-        combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
-                             gate_vals)
-        dispatched = jnp.einsum("tec,td->ecd", dispatch,
-                                tokens.astype(jnp.float32)).astype(self.dtype)
+        with jax.named_scope("moe_dispatch"):
+            # Dispatch mask [T, k, E, C] -> combined [T, E, C].
+            cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                        dtype=jnp.float32)  # [T,k,C]
+            dispatch = jnp.einsum("tke,tkc->tec", onehot,
+                                  cap_onehot * within_cap[..., None])
+            combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
+                                 gate_vals)
+            dispatched = jnp.einsum(
+                "tec,td->ecd", dispatch,
+                tokens.astype(jnp.float32)).astype(self.dtype)
         expert_out = self._experts(dispatched)
-        return jnp.einsum("tec,ecd->td", combine,
-                          expert_out.astype(jnp.float32))
+        with jax.named_scope("moe_combine"):
+            return jnp.einsum("tec,ecd->td", combine,
+                              expert_out.astype(jnp.float32))
 
 
 #: Expert-parallel rules: stacked expert weights shard on the 'expert' axis

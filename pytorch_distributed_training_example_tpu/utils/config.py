@@ -47,6 +47,11 @@ class Config:
     # dots_no_batch | attn_out — see models.llama.REMAT_POLICIES
     remat_policy: str = "nothing"
     grad_accum_steps: int = 1  # microbatches per optimizer step (in-step scan)
+    # MoE routing/dispatch (llama_moe family; parallel/moe.py)
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_impl: str = "gather"  # sort | gather | einsum
+    moe_combine_dtype: str = "fp32"  # fp32 (exact) | bf16 (combine-BW A/B)
     pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)
     strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)

@@ -23,6 +23,11 @@ import pytest  # noqa: E402
 from pytorch_distributed_training_example_tpu.ops import pallas_compat  # noqa: E402,F401
 
 jax.config.update("jax_platforms", "cpu")
+# jax 0.4.x defaults threefry_partitionable=False, where sharded param init
+# produces DIFFERENT bits than single-device init — the TP-vs-single-device
+# equivalence tests then compare two different models. True is the jax 0.5+
+# default and what main.py sets for real runs; mirror it here.
+jax.config.update("jax_threefry_partitionable", True)
 # Persistent compile cache: XLA:CPU compiles dominate suite wall time
 # (25s -> ~7s for a ResNet-18 train step on re-runs). Machine-local cache in
 # /tmp — never shipped; safe because re-runs happen on the same host.
