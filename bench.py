@@ -51,7 +51,7 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                moe_capacity_factor: float = 1.25,
                moe_top_k: int = 2, moe_dispatch_impl: str = "gather",
                moe_combine_dtype: str = "fp32",
-               remat_policy: str = "nothing"):
+               remat_policy: str = "nothing", telemetry: bool = False):
     """Build (mesh, state, step_fn, device batch, bundle) exactly as the
     benchmark measures them — shared by bench() and benchmarks/profile_step.py
     so profiles describe the same program the headline numbers time."""
@@ -86,7 +86,7 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                                           bundle.input_template, mesh, rules,
                                           seed=0)
     task = train_loop.get_task(bundle.task)
-    step = train_loop.make_train_step(task)
+    step = train_loop.make_train_step(task, health=telemetry)
 
     batch = make_synthetic_batch(bundle, global_batch, image_size, seq_len,
                                  cfg.num_classes)
@@ -104,7 +104,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           remat: bool = False, devices=None, attn_impl: str = "auto",
           moe_capacity_factor: float = 1.25, moe_top_k: int = 2,
           moe_dispatch_impl: str = "gather", moe_combine_dtype: str = "fp32",
-          remat_policy: str = "nothing"):
+          remat_policy: str = "nothing", telemetry: bool = False):
     import jax
     import numpy as np
 
@@ -116,7 +116,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                     moe_capacity_factor=moe_capacity_factor,
                     moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
                     moe_combine_dtype=moe_combine_dtype,
-                    remat_policy=remat_policy)
+                    remat_policy=remat_policy, telemetry=telemetry)
     mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
                                         su["batch"], su["bundle"])
     strategy, global_batch = su["strategy"], su["global_batch"]
@@ -129,22 +129,35 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
     def run_steps(state, batch):
         def body(s, _):
             s, metrics = step(s, batch)
-            return s, metrics["loss"]
-        state, losses = jax.lax.scan(body, state, None, length=steps)
-        return state, losses
+            # With telemetry on, return the WHOLE metrics dict: returning
+            # only the loss would let XLA dead-code-eliminate the health
+            # pack, and the "telemetry overhead" measurement would time
+            # nothing. All entries are scalars, so the stacked output is
+            # a few KB either way.
+            return s, (metrics if telemetry else metrics["loss"])
+        return jax.lax.scan(body, state, None, length=steps)
+
+    def fetch(out):
+        # Force execution (and a host round-trip, like the trainer's
+        # log_every device_get). With telemetry, `out` is the full metrics
+        # dict — fetching all of it keeps the health pack live.
+        return {k: np.asarray(v) for k, v in out.items()} if telemetry \
+            else np.asarray(out)
 
     with mesh_lib.use_mesh(mesh):
         compiled = run_steps.lower(state, batch).compile()
-        state, losses = compiled(state, batch)  # warm (first run pays setup)
-        np.asarray(losses)
+        state, out = compiled(state, batch)  # warm (first run pays setup)
+        fetch(out)
         dt = float("inf")
         for _ in range(max(warmup // max(steps, 1), 2)):
             t0 = time.perf_counter()
-            state, losses = compiled(state, batch)
-            np.asarray(losses)  # forces execution; per-step losses are real
+            state, out = compiled(state, batch)
+            fetch(out)  # forces execution; per-step losses are real
             dt = min(dt, time.perf_counter() - t0)
     try:
         ca = compiled.cost_analysis() or {}
+        if isinstance(ca, list):  # XLA:CPU returns [dict], TPU a dict
+            ca = ca[0] if ca else {}
     except Exception:
         ca = {}
 
@@ -207,6 +220,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             "precision": precision,
             "strategy": strategy,
             "attn_impl": attn_impl,
+            **({"telemetry": True} if telemetry else {}),
             **({"moe_dispatch_impl": moe_dispatch_impl,
                 "moe_top_k": moe_top_k,
                 "moe_combine_dtype": moe_combine_dtype,
@@ -414,6 +428,10 @@ def main(argv=None):
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "xla", "flash", "ring", "ring_zigzag",
                             "ulysses"])
+    p.add_argument("--telemetry", action="store_true",
+                   help="compile the on-device health pack into the step "
+                        "(utils/telemetry.py) — measures its overhead vs "
+                        "the default row")
     p.add_argument("--no-measured-roofline", action="store_true",
                    help="skip the xplane-measured roofline pass (resnet50 "
                         "headline only; ~2 min extra)")
@@ -435,7 +453,7 @@ def main(argv=None):
                    moe_top_k=args.moe_top_k,
                    moe_dispatch_impl=args.moe_dispatch,
                    moe_combine_dtype=args.moe_combine,
-                   remat_policy=args.remat_policy)
+                   remat_policy=args.remat_policy, telemetry=args.telemetry)
     if (args.model == "resnet50" and not args.no_measured_roofline):
         # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer
         # traffic from the scheduled HLO joined with xplane durations —

@@ -36,6 +36,39 @@ def iter_rows(result: dict):
         yield lm["metric"], float(lm["value"]), result.get("extra", {})
 
 
+def check_health(jsonl_path: str):
+    """Scan a run's metrics.jsonl for non-finite training-health scalars.
+
+    A golden run whose health pack went NaN/inf mid-run produced its
+    throughput number while training garbage — flag it even if the
+    images/sec headline looks fine. (``json.loads`` accepts the bare
+    ``NaN``/``Infinity`` tokens Python's json.dump emits, so the scan sees
+    them as real floats.)
+    """
+    import math
+
+    failures, report = [], []
+    with open(jsonl_path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("kind") not in (None, "train", "health"):
+                continue
+            bad = [k for k, v in row.items()
+                   if not isinstance(v, bool) and isinstance(v, (int, float))
+                   and not math.isfinite(v)]
+            if bad:
+                msg = (f"{jsonl_path}:{ln}: non-finite health scalar(s) "
+                       f"{bad} at step {row.get('step', '?')}")
+                failures.append(msg)
+                report.append("NON-FINITE " + msg)
+    if not failures:
+        report.append(f"HEALTH-OK {jsonl_path}: all scalars finite")
+    return failures, report
+
+
 def check(result: dict, golden: dict, tolerance: float = 0.10):
     """Returns (failures, report_lines); a failure is a >tolerance drop."""
     device = result.get("extra", {}).get("device", "")
@@ -61,16 +94,30 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("result", nargs="?", help="bench JSON file (default: stdin)")
     p.add_argument("--tolerance", type=float, default=0.10)
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="also scan this run's metrics.jsonl for non-finite "
+                        "training-health scalars (telemetry rows); any hit "
+                        "fails the gate")
     args = p.parse_args(argv)
-    raw = open(args.result).read() if args.result else sys.stdin.read()
-    # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result under
-    # "parsed") or piped bench.py output (last stdout line is the JSON).
-    try:
-        data = json.loads(raw)
-    except json.JSONDecodeError:
-        data = json.loads(raw.strip().splitlines()[-1])
-    result = data.get("parsed", data)
-    failures, report = check(result, load_golden(), args.tolerance)
+    failures, report = [], []
+    # --metrics-jsonl alone is a health-only scan (no bench row expected on
+    # stdin); a positional result file, or plain piped usage, still runs the
+    # golden comparison.
+    if args.result or not args.metrics_jsonl:
+        raw = open(args.result).read() if args.result else sys.stdin.read()
+        # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result
+        # under "parsed") or piped bench.py output (last stdout line is the
+        # JSON).
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError:
+            data = json.loads(raw.strip().splitlines()[-1])
+        result = data.get("parsed", data)
+        failures, report = check(result, load_golden(), args.tolerance)
+    if args.metrics_jsonl:
+        h_failures, h_report = check_health(args.metrics_jsonl)
+        failures += h_failures
+        report += h_report
     for line in report:
         print(line)
     return 1 if failures else 0

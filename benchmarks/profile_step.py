@@ -315,7 +315,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             remat_policy="nothing",
             attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
             moe_dispatch_impl="gather", moe_combine_dtype="fp32",
-            steps=3, trace_dir=None, top=25):
+            steps=3, trace_dir=None, top=25, telemetry=False):
     import jax
 
     from bench import setup_step
@@ -330,7 +330,8 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
                     moe_capacity_factor=moe_capacity_factor,
                     moe_top_k=moe_top_k,
                     moe_dispatch_impl=moe_dispatch_impl,
-                    moe_combine_dtype=moe_combine_dtype)
+                    moe_combine_dtype=moe_combine_dtype,
+                    telemetry=telemetry)
     mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
     bundle = su["bundle"]
     trace_dir = trace_dir or tempfile.mkdtemp(prefix="xprof_")
@@ -586,6 +587,10 @@ def main(argv=None):
     p.add_argument("--moe-capacity-factor", type=float, default=1.25)
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
+    p.add_argument("--telemetry", action="store_true",
+                   help="profile the step WITH the on-device health pack "
+                        "compiled in (utils/telemetry.py) — its reductions "
+                        "show up under the telemetry_health named scope")
     p.add_argument("--aot", action="store_true",
                    help="no-chip mode: AOT-lower with abstract inputs and "
                         "report static per-moe-region program facts "
@@ -616,7 +621,7 @@ def main(argv=None):
                   moe_top_k=args.moe_top_k,
                   moe_dispatch_impl=args.moe_dispatch,
                   moe_combine_dtype=args.moe_combine,
-                  steps=args.steps, top=args.top)
+                  steps=args.steps, top=args.top, telemetry=args.telemetry)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(res, f, indent=1)

@@ -98,6 +98,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluate", action="store_true",
                    help="evaluation only (use with --resume to score a "
                         "checkpoint); no training")
+    p.add_argument("--telemetry", action="store_true", default=None,
+                   help="unified telemetry: on-device health pack in the "
+                        "metrics rows, span timeline + goodput accounting "
+                        "(trace_events.json/goodput.json in the checkpoint "
+                        "dir), anomaly guard")
+    p.add_argument("--health-every", type=int, default=None,
+                   dest="health_every",
+                   help="with --telemetry: also fetch/check the health pack "
+                        "every N steps (0 = ride the log-every fetch only)")
+    p.add_argument("--anomaly-action", default=None, dest="anomaly_action",
+                   choices=["abort", "continue"],
+                   help="on a non-finite health scalar: dump a diagnostic "
+                        "bundle then abort (raise) or keep training")
     p.add_argument("--profile-steps", default=None,
                    help="'start:stop' global-step range to trace")
     p.add_argument("--fault-inject", default=None,

@@ -157,7 +157,7 @@ def create_train_state(
 # ---------------------------------------------------------------------------
 
 
-def make_train_step(task, grad_accum: int = 1) -> Callable:
+def make_train_step(task, grad_accum: int = 1, health: bool = False) -> Callable:
     """Build the pure ``(state, batch) -> (state, metrics)`` function.
 
     Callers wrap it in ``jax.jit(..., donate_argnums=0)`` under the mesh:
@@ -170,14 +170,27 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
     optimizer update — same numbers as the large batch (equivalence-tested)
     at 1/G the activation memory. BatchNorm running stats chain through the
     microbatches sequentially.
+
+    ``health=True`` adds the telemetry health pack to the metrics dict:
+    update/param norms, finite flags (utils/telemetry.health_pack) and any
+    scalars the model sows under the ``"telemetry"`` collection (MoE
+    router-load entropy / drop fraction). All on-device; the scalars ride
+    the same device_get the loss already takes, so there is no extra host
+    sync — only the small fused reductions inside the step.
     """
+    from pytorch_distributed_training_example_tpu.utils import (
+        telemetry as telemetry_lib)
 
     def compute_grads(state: TrainState, batch: dict, step_rng, batch_stats):
         def loss_fn(params):
             variables = {"params": params}
             # "losses" collects model-internal auxiliary terms (MoE load
-            # balancing); "batch_stats" is BatchNorm's running stats.
+            # balancing); "batch_stats" is BatchNorm's running stats;
+            # "telemetry" (health runs only) collects model diagnostics —
+            # sow() is a no-op when the collection isn't mutable.
             mutable = ["losses"]
+            if health:
+                mutable.append("telemetry")
             if batch_stats is not None:
                 variables["batch_stats"] = batch_stats
                 mutable.append("batch_stats")
@@ -188,8 +201,10 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
             loss = task.loss(logits, batch)
             for aux in jax.tree.leaves(new_vars.get("losses", {})):
                 loss = loss + aux
+            tele = (telemetry_lib.collect_sowed(new_vars["telemetry"])
+                    if health and "telemetry" in new_vars else {})
             scaled = state.scaler.scale_loss(loss) if state.scaler is not None else loss
-            return scaled, (loss, logits, new_vars.get("batch_stats"))
+            return scaled, (loss, logits, new_vars.get("batch_stats"), tele)
 
         return jax.grad(loss_fn, has_aux=True)(state.params)
 
@@ -198,7 +213,7 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
                     if state.rng is not None else jax.random.PRNGKey(0))
 
         if grad_accum <= 1:
-            grads, (loss, logits, new_batch_stats) = compute_grads(
+            grads, (loss, logits, new_batch_stats, tele) = compute_grads(
                 state, batch, step_rng, state.batch_stats)
             task_metrics = task.metrics(logits, batch)
         else:
@@ -209,14 +224,15 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
                     P(None, mesh_lib.BATCH_AXES)), batch)
 
             def body(carry, xs):
-                g_acc, l_acc, m_acc, bs, i = carry
+                g_acc, l_acc, m_acc, t_acc, bs, i = carry
                 mb, = xs
-                g, (l, logits, new_bs) = compute_grads(
+                g, (l, logits, new_bs, t) = compute_grads(
                     state, mb, jax.random.fold_in(step_rng, i), bs)
                 g_acc = jax.tree.map(jnp.add, g_acc, g)
                 m_acc = jax.tree.map(jnp.add, m_acc, task.metrics(logits, mb))
+                t_acc = jax.tree.map(jnp.add, t_acc, t)
                 bs = new_bs if new_bs is not None else bs
-                return (g_acc, l_acc + l, m_acc, bs, i + 1), None
+                return (g_acc, l_acc + l, m_acc, t_acc, bs, i + 1), None
 
             # Zero-seeded carry (shapes via eval_shape, so the traced program
             # contains ONE copy of forward+backward, not an unrolled first
@@ -229,19 +245,24 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
                             {"batch_stats": state.batch_stats}
                             if state.batch_stats is not None else {})},
                         *[mb0[k] for k in task.inputs], train=False), mb0))
+            t_shape = jax.eval_shape(compute_grads, state, mb0, step_rng,
+                                     state.batch_stats)[1][3]
+            zeros = lambda s: jnp.zeros(s.shape, s.dtype)
             carry0 = (
                 jax.tree.map(jnp.zeros_like, state.params),
                 jnp.zeros((), jnp.float32),
-                jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), m_shape),
+                jax.tree.map(zeros, m_shape),
+                jax.tree.map(zeros, t_shape),
                 state.batch_stats,
                 jnp.int32(0),
             )
-            (grads, loss, task_metrics, new_batch_stats, _), _ = jax.lax.scan(
-                body, carry0, (micro,))
+            (grads, loss, task_metrics, tele, new_batch_stats, _), _ = \
+                jax.lax.scan(body, carry0, (micro,))
             inv = 1.0 / G
             grads = jax.tree.map(lambda g: g * inv, grads)
             loss = loss * inv
             task_metrics = jax.tree.map(lambda m: m * inv, task_metrics)
+            tele = jax.tree.map(lambda t: t * inv, tele)
 
         bn_update = ({"batch_stats": new_batch_stats}
                      if new_batch_stats is not None else {})
@@ -264,6 +285,10 @@ def make_train_step(task, grad_accum: int = 1) -> Callable:
         metrics = {"loss": loss, **task_metrics,
                    **task.metrics_from_loss(loss),
                    "grad_norm": global_norm(grads)}
+        if health:
+            metrics.update(tele)
+            metrics.update(telemetry_lib.health_pack(
+                loss, grads, state.params, new_state.params))
         if state.scaler is not None:
             metrics["loss_scale"] = new_scaler.scale
             metrics["grads_finite"] = finite.astype(jnp.float32)

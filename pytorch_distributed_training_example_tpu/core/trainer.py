@@ -9,7 +9,9 @@ are fetched every ``log_every`` steps.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import tempfile
 import time
 
 import jax
@@ -33,6 +35,7 @@ from pytorch_distributed_training_example_tpu.data import (
 from pytorch_distributed_training_example_tpu.models import registry
 from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
 from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+from pytorch_distributed_training_example_tpu.utils import telemetry as telemetry_lib
 from pytorch_distributed_training_example_tpu.utils import watchdog as watchdog_lib
 from pytorch_distributed_training_example_tpu.utils.config import Config
 from pytorch_distributed_training_example_tpu.utils.logging import (
@@ -48,6 +51,35 @@ class Trainer:
             if cfg.checkpoint_dir else None,
             tensorboard_dir=cfg.tensorboard_dir)
 
+        # Telemetry layer (utils/telemetry.py): span recorder + anomaly
+        # guard; also flips the compiled step's on-device health pack on.
+        # Created FIRST so the init/compile/restore phases are on the
+        # timeline too.
+        self.telemetry = None
+        self._watchdog: watchdog_lib.Watchdog | None = None
+        self._compiled = False
+        if cfg.telemetry:
+            tdir = cfg.checkpoint_dir or os.path.join(
+                tempfile.gettempdir(), "pdtx_telemetry")
+            self.telemetry = telemetry_lib.Telemetry(
+                tdir, run_id=self.metric_logger.run_id,
+                anomaly_action=cfg.anomaly_action, config=cfg,
+                allow_scaler_skips=(cfg.precision == "fp16"))
+            log.info("telemetry on: health pack in metrics, spans/goodput/"
+                     "anomaly bundles -> %s", tdir)
+
+        init_span = self._span("init")
+        init_span.__enter__()
+        try:
+            self._init_workload(cfg, mesh)
+        finally:
+            init_span.__exit__(None, None, None)
+
+    def _span(self, name: str):
+        return (self.telemetry.span(name) if self.telemetry is not None
+                else contextlib.nullcontext())
+
+    def _init_workload(self, cfg: Config, mesh=None):
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(cfg.mesh_config())
         self.policy = precision_lib.get_policy(cfg.precision)
 
@@ -136,7 +168,8 @@ class Trainer:
 
         task = train_loop.get_task(self.bundle.task, cfg.label_smoothing)
         self.train_step = jax.jit(
-            train_loop.make_train_step(task, cfg.grad_accum_steps),
+            train_loop.make_train_step(task, cfg.grad_accum_steps,
+                                       health=cfg.telemetry),
             donate_argnums=0)
         self.eval_step = jax.jit(train_loop.make_eval_step(task))
         self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
@@ -221,7 +254,8 @@ class Trainer:
             if step is None:
                 log.info("resume requested but no committed checkpoint in %s", directory)
                 return
-        self.state, extra = self.checkpointer.restore(self.state, step)
+        with self._span("checkpoint_restore"):
+            self.state, extra = self.checkpointer.restore(self.state, step)
         epoch = int(extra.get("epoch", -1))
         # Epoch-boundary checkpoints carry no step_offset (the epoch is
         # complete); mid-epoch ones record how many steps of `epoch` were
@@ -275,22 +309,46 @@ class Trainer:
                  "steps_per_epoch": self.steps_per_epoch}
         if step_offset is not None:
             extra["step_offset"] = step_offset
-        self.checkpointer.save(self.state, step, extra=extra)
+        with self._span("checkpoint_save"):
+            self.checkpointer.save(self.state, step, extra=extra)
         self._last_saved_step = step
 
     # -- loops -------------------------------------------------------------
 
     def train(self):
         cfg = self.cfg
-        for epoch in range(self.start_epoch, cfg.epochs):
-            self.train_epoch(epoch)
-            if (epoch + 1) % cfg.eval_every_epochs == 0:
-                self.evaluate(epoch)
-            if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
-                self._save(epoch)
-        if self.checkpointer:
-            self.checkpointer.wait()
-        self.metric_logger.close()
+        # One run-level watchdog spanning train AND eval (both loops beat it,
+        # so a long eval never false-triggers); its timeout dump carries the
+        # telemetry snapshot — last step, last health row, goodput — when on.
+        self._watchdog = watchdog_lib.Watchdog(
+            timeout_s=1800,
+            context_fn=(self.telemetry.snapshot
+                        if self.telemetry is not None else None)).start()
+        try:
+            for epoch in range(self.start_epoch, cfg.epochs):
+                self.train_epoch(epoch)
+                if (epoch + 1) % cfg.eval_every_epochs == 0:
+                    self.evaluate(epoch)
+                if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                    self._save(epoch)
+                if self.telemetry is not None:
+                    g = self.telemetry.emit(f"epoch {epoch}")
+                    self.metric_logger.write(
+                        kind="goodput", epoch=epoch, wall_s=g["wall_s"],
+                        goodput_fraction=g["goodput_fraction"],
+                        badput_fraction=g["badput_fraction"],
+                        coverage=g["coverage"],
+                        **{f"frac_{k}": v for k, v in g["fractions"].items()})
+            if self.checkpointer:
+                self.checkpointer.wait()
+        finally:
+            self._watchdog.stop()
+            self._watchdog = None
+            if self.telemetry is not None:
+                # Shutdown emit runs even on an anomaly abort, so the
+                # timeline + goodput files always reflect the full run.
+                self.telemetry.emit("shutdown")
+            self.metric_logger.close()
         return self.state
 
     def train_epoch(self, epoch: int):
@@ -304,11 +362,20 @@ class Trainer:
         tput = Throughput()
         t_step = time.perf_counter()
         it = prefetch.device_prefetch(self.train_loader, self.batch_sharding)
-        watchdog = watchdog_lib.Watchdog(timeout_s=1800).start()
+        # train() owns the run-level watchdog; a direct train_epoch() call
+        # (tests, notebooks) gets a per-epoch one with the same context hook.
+        watchdog = self._watchdog
+        own_watchdog = watchdog is None
+        if own_watchdog:
+            watchdog = watchdog_lib.Watchdog(
+                timeout_s=1800,
+                context_fn=(self.telemetry.snapshot
+                            if self.telemetry is not None else None)).start()
         try:
             self._train_epoch_inner(epoch, it, loss_m, tput, t_step, watchdog)
         finally:
-            watchdog.stop()
+            if own_watchdog:
+                watchdog.stop()
             errs = getattr(getattr(self.train_loader, "engine", None),
                            "decode_errors", None)
             if errs is not None and errs() > 0:
@@ -317,11 +384,18 @@ class Trainer:
 
     def _train_epoch_inner(self, epoch, it, loss_m, tput, t_step, watchdog):
         cfg = self.cfg
+        tele = self.telemetry
         with mesh_lib.use_mesh(self.mesh):
-            for i, batch in enumerate(it, self.train_loader.start_batch):
+            i = self.train_loader.start_batch
+            while i < self.steps_per_epoch:
+                # Host wait on the input pipeline is its own badput bucket —
+                # with the prefetcher keeping up this span is ~0.
+                with self._span("input_wait"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
                 watchdog.beat()
-                if i >= self.steps_per_epoch:
-                    break
                 gstep = epoch * self.steps_per_epoch + i
                 if (self.fault_inject
                         and jax.process_index() == self.fault_inject[0]
@@ -333,7 +407,17 @@ class Trainer:
                     os._exit(57)
                 if self.profile_range and gstep == self.profile_range[0]:
                     jax.profiler.start_trace(cfg.profile_dir)
-                self.state, metrics = self.train_step(self.state, batch)
+                if not self._compiled:
+                    # First dispatch ever traces + compiles; block so the
+                    # "compile" span covers it (dispatch is async — without
+                    # the block the cost would leak into later step spans).
+                    with self._span("compile"):
+                        self.state, metrics = self.train_step(self.state, batch)
+                        jax.tree.map(lambda x: x.block_until_ready(), metrics)
+                    self._compiled = True
+                else:
+                    with self._span("step"):
+                        self.state, metrics = self.train_step(self.state, batch)
                 if (cfg.checkpoint_every_steps
                         and (gstep + 1) % cfg.checkpoint_every_steps == 0):
                     # Step-cadence save: records (epoch, steps applied) so
@@ -347,8 +431,24 @@ class Trainer:
                     jax.profiler.stop_trace()
                     log.info("profile written to %s", cfg.profile_dir)
                 tput.update(cfg.global_batch_size)
-                if (i + 1) % cfg.log_every == 0 or i + 1 == self.steps_per_epoch:
-                    m = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                is_log = ((i + 1) % cfg.log_every == 0
+                          or i + 1 == self.steps_per_epoch)
+                is_health = (tele is not None and cfg.health_every > 0
+                             and (i + 1) % cfg.health_every == 0)
+                if is_log or is_health:
+                    # The fetch drains the async step queue: that wait IS
+                    # device step time, so it stays in the "step" bucket.
+                    with self._span("step"):
+                        m = {k: float(v)
+                             for k, v in jax.device_get(metrics).items()}
+                    if tele is not None:
+                        # May raise AnomalyError (anomaly_action="abort")
+                        # after writing the diagnostic bundle.
+                        tele.observe(gstep, {"epoch": epoch, **m})
+                    if not is_log:
+                        self.metric_logger.write(kind="health", epoch=epoch,
+                                                 step=gstep, **m)
+                if is_log:
                     loss_m.update(m["loss"])
                     lr = float(self.schedule(gstep))
                     dt = (time.perf_counter() - t_step) / cfg.log_every
@@ -366,13 +466,16 @@ class Trainer:
                     )
                     self.metric_logger.write(kind="train", epoch=epoch, step=gstep,
                                              lr=lr, rate=rate, mfu=mfu, **m)
+                i += 1
 
     def evaluate(self, epoch: int):
         sums: dict[str, float] = {}
         n_batches = 0
         padded = (prefetch.pad_batch(b, self.local_batch) for b in self.eval_loader)
-        with mesh_lib.use_mesh(self.mesh):
+        with self._span("eval"), mesh_lib.use_mesh(self.mesh):
             for batch in prefetch.device_prefetch(padded, self.batch_sharding):
+                if self._watchdog is not None:
+                    self._watchdog.beat()
                 stats = self.eval_step(self.state, batch)
                 m = {k: float(v) for k, v in jax.device_get(stats).items()}
                 for k, v in m.items():

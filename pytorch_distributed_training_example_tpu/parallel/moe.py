@@ -133,6 +133,13 @@ class MoEBlock(nn.Module):
                        .transpose(1, 0, 2) * onehot).sum(-1)        # [T, k]
                 within_cap = pos < capacity
                 gate_vals = gate_vals * within_cap
+                # Telemetry (ST-MoE router diagnostics): fraction of
+                # (token, choice) assignments beyond expert capacity. sow is
+                # a no-op unless the step runs with the "telemetry"
+                # collection mutable (utils/telemetry health pack), and XLA
+                # DCEs the unused mean in that case.
+                self.sow("telemetry", "moe_drop_fraction",
+                         1.0 - jnp.mean(within_cap.astype(jnp.float32)))
 
             if self.dispatch_impl == "einsum":
                 out = self._einsum_route(tokens, onehot, pos, within_cap,
@@ -153,6 +160,14 @@ class MoEBlock(nn.Module):
             z = jnp.mean(
                 jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
             self.sow("losses", "moe_z_loss", self.z_loss_weight * z)
+            # Telemetry: entropy of the routed-load distribution over all k
+            # choices (pre-capacity), normalized by ln(E) so 1.0 = perfectly
+            # balanced, 0.0 = collapsed onto one expert. Sown under the
+            # "telemetry" collection — free unless the health pack is on.
+            load = jax.nn.one_hot(expert_idx, E,
+                                  dtype=jnp.float32).mean((0, 1))  # [E]
+            ent = -jnp.sum(load * jnp.log(load + 1e-9)) / jnp.log(float(E))
+            self.sow("telemetry", "router_load_entropy", ent)
 
         return out.reshape(B, S, d).astype(self.dtype)
 
@@ -226,6 +241,10 @@ class MoEBlock(nn.Module):
             pos = pos_flat.reshape(k, T).T                          # [T, k]
             within_cap = pos < capacity
             gate_vals = gate_vals * within_cap
+            # Same telemetry scalar as the gather/einsum path (positions are
+            # drop-for-drop identical across dispatch impls).
+            self.sow("telemetry", "moe_drop_fraction",
+                     1.0 - jnp.mean(within_cap.astype(jnp.float32)))
 
             # Expert e's queue = sorted entries [starts[e], starts[e]+C):
             # one [E, C] take of token rows — no E*C scatter, no [T,k,E]

@@ -80,6 +80,16 @@ class Config:
     resume: str | None = None  # path | "auto"
     evaluate: bool = False  # eval-only mode (main.py --evaluate)
     seed: int = 0
+    # telemetry (utils/telemetry.py): on-device health pack in the metrics
+    # dict + host span timeline / goodput accounting + anomaly guard
+    telemetry: bool = False
+    # 0 = health rows ride the log_every fetch only (zero extra host syncs);
+    # N > 0 also fetches/checks the health pack every N steps (kind="health"
+    # JSONL rows between the train rows)
+    health_every: int = 0
+    # on a non-finite health scalar: dump a diagnostic bundle then
+    # "abort" (raise) | "continue" (log and keep training)
+    anomaly_action: str = "abort"
     # profiling
     profile_steps: str | None = None  # "start:stop" step range
     profile_dir: str = "/tmp/pdtx_profile"

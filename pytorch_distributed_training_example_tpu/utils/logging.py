@@ -12,6 +12,7 @@ import logging
 import os
 import sys
 import time
+import uuid
 
 import jax
 
@@ -39,9 +40,14 @@ class MetricLogger:
     JSONL-only with one warning."""
 
     def __init__(self, jsonl_path: str | None = None,
-                 tensorboard_dir: str | None = None):
+                 tensorboard_dir: str | None = None,
+                 run_id: str | None = None):
         self._fh = None
         self._tb = None
+        # Every row is stamped with a per-process run id: --resume appends
+        # to the same metrics.jsonl, so without it reruns of one experiment
+        # are indistinguishable in the file.
+        self.run_id = run_id or uuid.uuid4().hex[:12]
         self._steps: dict[str, int] = {}  # per-kind last x-value (ADVICE r4)
         # When the trainer sets this, epoch-keyed rows (eval) are converted
         # to the global-step axis so train and eval scalars are comparable.
@@ -60,6 +66,7 @@ class MetricLogger:
     def write(self, **metrics):
         if self._fh is not None:
             metrics.setdefault("time", time.time())
+            metrics.setdefault("run_id", self.run_id)
             self._fh.write(json.dumps(metrics, default=float) + "\n")
             self._fh.flush()
         if self._tb is not None:
@@ -113,7 +120,10 @@ class AverageMeter:
         self.avg = self.sum / max(self.count, 1)
 
     def __str__(self):
-        return f"{self.name} {format(self.val, self.fmt[1:])} ({format(self.avg, self.fmt[1:])})"
+        # fmt may be given with or without the format-spec colon (":.4f" or
+        # ".4f"); the old [1:] slice silently mangled the latter into "4f".
+        spec = self.fmt[1:] if self.fmt.startswith(":") else self.fmt
+        return f"{self.name} {format(self.val, spec)} ({format(self.avg, spec)})"
 
 
 class Throughput:

@@ -1,0 +1,340 @@
+"""Unified telemetry: on-device health pack, span timeline, goodput, anomaly guard.
+
+Three pieces, one module (ROADMAP items 1/3/5 all need this to be
+interpretable):
+
+1. **Health pack** (device side): ``health_pack`` computes global grad/update/
+   param norms and finite flags INSIDE the compiled train step, and
+   ``collect_sowed`` folds model-internal diagnostics (MoE router-load
+   entropy, drop fraction — sowed under the ``"telemetry"`` collection) into
+   the same metrics dict. Everything rides the existing ``log_every``
+   device_get: zero extra host syncs at the default cadence.
+
+2. **Span recorder** (host side): ``SpanRecorder.span("input_wait")`` times
+   named phases, mirrors them onto the device timeline via
+   ``jax.profiler.TraceAnnotation`` (so they line up with xplane traces), and
+   emits a Perfetto-loadable ``trace_events.json`` plus a goodput summary —
+   fraction of wall-clock in productive steps vs. each badput category
+   (PaLM-style goodput accounting, PAPERS.md).
+
+3. **Anomaly guard**: on a non-finite health scalar, dump a diagnostic
+   bundle (step, config, last-K metric rows, trigger row, goodput snapshot)
+   and either raise :class:`AnomalyError` or skip-and-continue, per the
+   ``--anomaly-action`` knob.
+
+The :class:`Telemetry` facade bundles all three for ``core/trainer.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("pdtx")
+
+#: Span names treated as productive time in the goodput summary.
+PRODUCTIVE_SPANS = ("step",)
+
+#: Badput categories the trainer emits (order is the report order).
+BADPUT_SPANS = ("init", "compile", "input_wait", "checkpoint_save",
+                "checkpoint_restore", "eval", "anomaly_dump")
+
+
+class AnomalyError(RuntimeError):
+    """Raised by the anomaly guard when ``anomaly_action='abort'``."""
+
+
+# ---------------------------------------------------------------------------
+# Device side: the health pack. Pure functions traced into the train step.
+# ---------------------------------------------------------------------------
+
+
+def _global_norm(tree) -> jax.Array:
+    import optax
+
+    return optax.global_norm(jax.tree.map(
+        lambda x: x.astype(jnp.float32), tree))
+
+
+def health_pack(loss, grads, old_params, new_params) -> dict[str, jax.Array]:
+    """Training-health scalars, computed where the tensors already live.
+
+    ``update_norm`` is the norm of the applied delta (new - old), so it is
+    exact under every update rule including the fp16 scaler's skip branch
+    (where it is 0: params held). All reductions fuse into the step program;
+    the result is a handful of f32 scalars in the metrics dict.
+    """
+    with jax.named_scope("telemetry_health"):
+        update = jax.tree.map(
+            lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+            new_params, old_params)
+        finite = jnp.all(jnp.stack(
+            [jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+             for g in jax.tree.leaves(grads)]))
+        return {
+            "update_norm": _global_norm(update),
+            "param_norm": _global_norm(new_params),
+            "loss_finite": jnp.isfinite(loss).astype(jnp.float32),
+            "grads_finite_all": finite.astype(jnp.float32),
+        }
+
+
+def collect_sowed(tele_vars) -> dict[str, jax.Array]:
+    """Fold a flax ``"telemetry"`` sow collection into named mean scalars.
+
+    Sow appends one entry per call site per layer (tuples; a leading scan
+    dim when layers are scanned) — group leaves by their final name and
+    average, so ``router_load_entropy`` is the mean over all MoE layers.
+    """
+    out: dict[str, list] = {}
+    flat = jax.tree_util.tree_flatten_with_path(tele_vars)[0]
+    for path, leaf in flat:
+        name = None
+        for part in reversed(path):
+            key = getattr(part, "key", getattr(part, "name", None))
+            if isinstance(key, str) and not key.isdigit():
+                name = key
+                break
+        if name is None:
+            name = "telemetry"
+        out.setdefault(name, []).append(jnp.mean(jnp.asarray(leaf)))
+    return {k: jnp.mean(jnp.stack(v)).astype(jnp.float32)
+            for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# Host side: span recorder + goodput accounting.
+# ---------------------------------------------------------------------------
+
+
+class SpanRecorder:
+    """Times named host-side phases and renders them two ways.
+
+    ``trace_events()`` is Chrome/Perfetto trace-event JSON (complete "X"
+    events, microsecond timestamps); ``goodput()`` is the wall-clock
+    decomposition. Only OUTERMOST spans accrue to the goodput totals —
+    nested spans (e.g. a checkpoint restore inside init) still appear on
+    the timeline but never double-count wall time. Each span also enters a
+    ``jax.profiler.TraceAnnotation`` so the phase shows up on xplane traces
+    captured by ``--profile-steps``.
+    """
+
+    def __init__(self, run_id: str = ""):
+        self.run_id = run_id
+        self._start = time.perf_counter()
+        self._events: list[dict] = []
+        self._totals: collections.defaultdict = collections.defaultdict(float)
+        self._counts: collections.defaultdict = collections.defaultdict(int)
+        self._depth = 0
+        self._pid = jax.process_index()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        ann = jax.profiler.TraceAnnotation(f"telemetry/{name}")
+        ann.__enter__()
+        t0 = time.perf_counter()
+        self._depth += 1
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._depth -= 1
+            ann.__exit__(None, None, None)
+            self._events.append({
+                "name": name, "ph": "X", "cat": "telemetry",
+                "ts": int((t0 - self._start) * 1e6),
+                "dur": int(dt * 1e6),
+                "pid": self._pid, "tid": self._depth,
+            })
+            if self._depth == 0:
+                self._totals[name] += dt
+                self._counts[name] += 1
+
+    @property
+    def wall_s(self) -> float:
+        return time.perf_counter() - self._start
+
+    def trace_events(self) -> dict:
+        return {"traceEvents": list(self._events),
+                "displayTimeUnit": "ms",
+                "otherData": {"run_id": self.run_id}}
+
+    def goodput(self) -> dict:
+        """Wall-clock decomposition since construction.
+
+        ``goodput_fraction`` is the productive ("step") share; ``coverage``
+        is the fraction of wall-clock any top-level span accounts for —
+        the acceptance bar asks for >= 0.95, the rest is loop bookkeeping.
+        Fractions sum to ``coverage`` <= 1 by construction (top-level spans
+        cannot overlap on one thread).
+        """
+        wall = max(self.wall_s, 1e-9)
+        cats = {k: round(v, 4) for k, v in sorted(self._totals.items())}
+        fracs = {k: v / wall for k, v in self._totals.items()}
+        good = sum(fracs.get(k, 0.0) for k in PRODUCTIVE_SPANS)
+        return {
+            "run_id": self.run_id,
+            "wall_s": round(wall, 4),
+            "categories_s": cats,
+            "counts": dict(self._counts),
+            "fractions": {k: round(v, 4) for k, v in sorted(fracs.items())},
+            "goodput_fraction": round(good, 4),
+            "badput_fraction": round(sum(fracs.values()) - good, 4),
+            "coverage": round(sum(fracs.values()), 4),
+        }
+
+    def write(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "trace_events.json"), "w") as fh:
+            json.dump(self.trace_events(), fh)
+        with open(os.path.join(directory, "goodput.json"), "w") as fh:
+            json.dump(self.goodput(), fh, indent=1)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly guard.
+# ---------------------------------------------------------------------------
+
+
+def _nonfinite_keys(row: dict) -> list[str]:
+    import math
+
+    bad = []
+    for k, v in row.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if not math.isfinite(v):
+            bad.append(k)
+    return bad
+
+
+class AnomalyGuard:
+    """Watches fetched metric rows for non-finite training-health scalars.
+
+    ``record`` keeps the last-K rows; ``check`` dumps a diagnostic bundle
+    (step, config, trigger row, history, goodput snapshot) into
+    ``directory`` on the first non-finite scalar and then either raises
+    :class:`AnomalyError` (action="abort") or logs and returns True
+    (action="continue"). With an fp16 GradScaler in play, rows whose
+    ``grads_finite`` flag is 0 are the scaler's *handled* overflow-skip
+    branch — set ``allow_scaler_skips`` so they don't false-trigger.
+    """
+
+    def __init__(self, directory: str, action: str = "abort", keep: int = 32,
+                 config: Any = None, run_id: str = "",
+                 goodput_fn: Callable[[], dict] | None = None,
+                 allow_scaler_skips: bool = False):
+        if action not in ("abort", "continue"):
+            raise ValueError(
+                f"anomaly_action must be 'abort' or 'continue', got {action!r}")
+        self.directory = directory
+        self.action = action
+        self.config = config
+        self.run_id = run_id
+        self.goodput_fn = goodput_fn
+        self.allow_scaler_skips = allow_scaler_skips
+        self.history: collections.deque = collections.deque(maxlen=keep)
+        self.tripped = False
+
+    def record(self, step: int, row: dict) -> None:
+        self.history.append({"step": int(step), **row})
+
+    def check(self, step: int, row: dict) -> bool:
+        """Record the row, then trip on any non-finite scalar in it."""
+        self.record(step, row)
+        if (self.allow_scaler_skips
+                and float(row.get("grads_finite", 1.0)) == 0.0):
+            return False  # fp16 overflow-skip: params held, not an anomaly
+        bad = _nonfinite_keys(row)
+        if not bad:
+            return False
+        self.tripped = True
+        path = self.dump(step, row, bad)
+        msg = (f"non-finite health scalar(s) {bad} at step {step}; "
+               f"diagnostic bundle: {path}")
+        if self.action == "abort":
+            raise AnomalyError(msg)
+        log.error("anomaly guard: %s — continuing (anomaly_action=continue)",
+                  msg)
+        return True
+
+    def dump(self, step: int, row: dict, bad_keys: list[str]) -> str:
+        cfg = self.config
+        if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+            cfg = dataclasses.asdict(cfg)
+        bundle = {
+            "run_id": self.run_id,
+            "step": int(step),
+            "trigger_keys": bad_keys,
+            "trigger_row": row,
+            "config": cfg,
+            "history": list(self.history),
+            "goodput": self.goodput_fn() if self.goodput_fn else None,
+            "time": time.time(),
+        }
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, f"anomaly_step{int(step):08d}.json")
+        with open(path, "w") as fh:
+            json.dump(bundle, fh, indent=1, default=float)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Facade: what the trainer holds.
+# ---------------------------------------------------------------------------
+
+
+class Telemetry:
+    """Span recorder + anomaly guard + last-seen state, as one object.
+
+    ``directory`` receives ``trace_events.json`` / ``goodput.json`` (epoch
+    end and shutdown) and anomaly bundles. ``snapshot()`` is the watchdog's
+    context hook: last global step, last health row, goodput decomposition.
+    """
+
+    def __init__(self, directory: str, run_id: str = "",
+                 anomaly_action: str = "abort", config: Any = None,
+                 history_keep: int = 32, allow_scaler_skips: bool = False):
+        self.directory = directory
+        self.recorder = SpanRecorder(run_id=run_id)
+        self.guard = AnomalyGuard(
+            directory, action=anomaly_action, keep=history_keep,
+            config=config, run_id=run_id, goodput_fn=self.recorder.goodput,
+            allow_scaler_skips=allow_scaler_skips)
+        self.last_step: int | None = None
+        self.last_health: dict | None = None
+
+    def span(self, name: str):
+        return self.recorder.span(name)
+
+    def observe(self, step: int, row: dict) -> bool:
+        """Feed one fetched metrics row; returns True if the guard tripped."""
+        self.last_step = int(step)
+        self.last_health = dict(row)
+        return self.guard.check(step, row)
+
+    def snapshot(self) -> dict:
+        return {"last_step": self.last_step,
+                "last_health": self.last_health,
+                "goodput": self.recorder.goodput()}
+
+    def emit(self, where: str = "") -> dict:
+        """Write the timeline + goodput files and log the one-line summary."""
+        self.recorder.write(self.directory)
+        g = self.recorder.goodput()
+        log.info(
+            "goodput%s: %.1f%% productive over %.1fs (coverage %.1f%%) — %s",
+            f" [{where}]" if where else "", 100 * g["goodput_fraction"],
+            g["wall_s"], 100 * g["coverage"],
+            " ".join(f"{k} {100 * v:.1f}%"
+                     for k, v in g["fractions"].items() if k != "step"))
+        return g

@@ -11,10 +11,12 @@ by the loader's deterministic per-slot queues.
 from __future__ import annotations
 
 import faulthandler
+import json
 import logging
 import sys
 import threading
 import time
+from typing import Callable
 
 import jax
 
@@ -24,16 +26,23 @@ log = logging.getLogger("pdtx")
 class Watchdog:
     """Dead-man's switch for the train loop (NCCL-watchdog equivalent).
 
-    ``beat()`` every step; if no beat arrives within ``timeout_s`` the
-    watchdog dumps all Python thread stacks to stderr (so a hung collective
-    is diagnosable post-mortem) and, with ``fatal=True``, aborts the process
-    so a supervisor can restart from the latest checkpoint — the TPU
-    recovery model (gang-scheduled slices restart; no elastic shrink).
+    ``beat()`` every step (the trainer beats from BOTH the train and eval
+    loops, so a long eval never false-triggers); if no beat arrives within
+    ``timeout_s`` the watchdog dumps all Python thread stacks to stderr (so
+    a hung collective is diagnosable post-mortem), logs ``context_fn()``
+    when provided (the trainer passes the telemetry snapshot: last global
+    step, last health-pack row, goodput decomposition — so the dump says
+    WHERE training was, not just which frames are parked), and, with
+    ``fatal=True``, aborts the process so a supervisor can restart from the
+    latest checkpoint — the TPU recovery model (gang-scheduled slices
+    restart; no elastic shrink).
     """
 
-    def __init__(self, timeout_s: float = 600.0, fatal: bool = False):
+    def __init__(self, timeout_s: float = 600.0, fatal: bool = False,
+                 context_fn: Callable[[], dict] | None = None):
         self.timeout_s = timeout_s
         self.fatal = fatal
+        self.context_fn = context_fn
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -58,6 +67,12 @@ class Watchdog:
                 log.error(
                     "watchdog: no step progress for %.0fs (timeout %.0fs) — "
                     "likely a hung collective; dumping stacks", idle, self.timeout_s)
+                if self.context_fn is not None:
+                    try:
+                        log.error("watchdog context: %s",
+                                  json.dumps(self.context_fn(), default=str))
+                    except Exception as e:  # never let context kill the dump
+                        log.error("watchdog context unavailable (%s)", e)
                 faulthandler.dump_traceback(file=sys.stderr)
                 if self.fatal:
                     import os
