@@ -12,6 +12,14 @@ spawns N processes with COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID set
 streams rank-0 output, and propagates the first non-zero exit — torchrun's
 contract, minus elasticity (TPU slices are gang-scheduled; recovery is
 restart-from-checkpoint, SURVEY.md §5 failure detection).
+
+Supervisor mode (``--restart-policy``): when a run exits with the distinct
+preemption code (resilience.PREEMPTED_EXIT_CODE — the trainer's
+graceful-shutdown path after a SIGTERM took its emergency checkpoint), or
+with any failure under ``on-failure``, the whole gang is relaunched with
+``--resume auto`` appended, up to ``--max-restarts`` times with exponential
+backoff. This is the "gang-scheduled slices get preempted and restart from
+the latest checkpoint" recovery loop, run locally.
 """
 
 from __future__ import annotations
@@ -22,6 +30,14 @@ import signal
 import socket
 import subprocess
 import sys
+import time
+
+try:
+    # resilience.py deliberately imports no jax — safe in the launcher.
+    from pytorch_distributed_training_example_tpu.utils.resilience import (
+        PREEMPTED_EXIT_CODE)
+except ImportError:  # stripped deployments: keep the launcher standalone
+    PREEMPTED_EXIT_CODE = 75
 
 
 def free_port() -> int:
@@ -30,24 +46,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--nprocs", type=int, default=2)
-    p.add_argument("--coordinator-port", type=int, default=None)
-    p.add_argument("--cpu-devices", type=int, default=0,
-                   help="fake CPU devices per process (testing without TPUs)")
-    p.add_argument("--log-dir", default="/tmp",
-                   help="directory for non-rank-0 stdout/stderr logs "
-                        "(launch_rankN.log)")
-    p.add_argument("cmd", nargs=argparse.REMAINDER,
-                   help="-- script.py args...")
-    args = p.parse_args(argv)
-    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
-    if not cmd:
-        p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
+_interrupted = False
 
+
+def run_once(args, cmd) -> int:
+    """Spawn the gang once, poll all ranks, return the first failure code."""
+    # Fresh port per attempt: the previous attempt's coordinator socket can
+    # linger in TIME_WAIT and wedge the rendezvous of a restart.
     port = args.coordinator_port or free_port()
-    os.makedirs(args.log_dir, exist_ok=True)
     procs = []
     for rank in range(args.nprocs):
         env = os.environ.copy()
@@ -73,7 +79,12 @@ def main(argv=None):
         procs.append(subprocess.Popen([sys.executable, *cmd], env=env,
                                       stdout=out, stderr=err))
 
-    def kill_all(*_):
+    def kill_all(*signal_args):
+        if signal_args:
+            # Operator-initiated teardown (Ctrl-C / SIGTERM to the launcher):
+            # the supervisor must NOT restart what the human just killed.
+            global _interrupted
+            _interrupted = True
         for pr in procs:
             if pr.poll() is None:
                 pr.terminate()
@@ -84,8 +95,6 @@ def main(argv=None):
     # Poll ALL ranks: the first failure tears the job down immediately
     # (a dead rank would otherwise leave the rest blocked in a collective
     # and the launcher hung in a serial wait()).
-    import time
-
     code = None
     while code is None:
         time.sleep(0.2)
@@ -102,6 +111,59 @@ def main(argv=None):
         except subprocess.TimeoutExpired:
             pr.kill()
     return code
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--nprocs", type=int, default=2)
+    p.add_argument("--coordinator-port", type=int, default=None)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="fake CPU devices per process (testing without TPUs)")
+    p.add_argument("--log-dir", default="/tmp",
+                   help="directory for non-rank-0 stdout/stderr logs "
+                        "(launch_rankN.log)")
+    p.add_argument("--restart-policy", default="never",
+                   choices=["never", "on-preempt", "on-failure"],
+                   help="supervisor mode: relaunch the gang with --resume "
+                        "auto after a preemption exit (code "
+                        f"{PREEMPTED_EXIT_CODE}; on-preempt) or after any "
+                        "non-zero exit (on-failure)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="restart budget for the supervisor (per launcher run)")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   help="base seconds between restarts; doubles per restart")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- script.py args...")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    restarts = 0
+    while True:
+        code = run_once(args, cmd)
+        if code == 0 or args.restart_policy == "never" or _interrupted:
+            return code
+        if args.restart_policy == "on-preempt" and code != PREEMPTED_EXIT_CODE:
+            return code
+        if restarts >= args.max_restarts:
+            print(f"launch.py: restart budget exhausted "
+                  f"({args.max_restarts}); last exit code {code}",
+                  file=sys.stderr)
+            return code
+        restarts += 1
+        delay = args.restart_backoff * 2 ** (restarts - 1)
+        print(f"launch.py: exit code {code} -> restart {restarts}/"
+              f"{args.max_restarts} with --resume auto in {delay:.1f}s",
+              file=sys.stderr)
+        time.sleep(delay)
+        if _interrupted:  # Ctrl-C during the backoff window
+            return code
+        if "--resume" not in cmd:
+            # argparse last-wins makes appending safe even if a later restart
+            # re-appends; guard anyway to keep the command line readable.
+            cmd = [*cmd, "--resume", "auto"]
 
 
 if __name__ == "__main__":

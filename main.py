@@ -108,9 +108,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --telemetry: also fetch/check the health pack "
                         "every N steps (0 = ride the log-every fetch only)")
     p.add_argument("--anomaly-action", default=None, dest="anomaly_action",
-                   choices=["abort", "continue"],
+                   choices=["abort", "continue", "rollback"],
                    help="on a non-finite health scalar: dump a diagnostic "
-                        "bundle then abort (raise) or keep training")
+                        "bundle then abort (raise), keep training, or "
+                        "rollback (restore last committed checkpoint and "
+                        "continue past the poisoned batches, bounded by "
+                        "--rollback-budget)")
+    p.add_argument("--rollback-budget", type=int, default=None,
+                   dest="rollback_budget",
+                   help="max anomaly rollbacks per run before escalating "
+                        "to abort")
+    p.add_argument("--watchdog-timeout", type=float, default=None,
+                   dest="watchdog_timeout",
+                   help="seconds without step progress before the watchdog "
+                        "dumps stacks and aborts")
+    p.add_argument("--chaos", default=None,
+                   help="deterministic fault injection spec, e.g. "
+                        "'sigterm@step=7,ckpt_io_error@save=2,"
+                        "nan_grad@step=5,loader_stall@batch=3,"
+                        "truncate_ckpt@save=1' (utils/chaos.py)")
+    p.add_argument("--chaos-seed", type=int, default=None, dest="chaos_seed",
+                   help="seed for chaos randomness (defaults to --seed)")
     p.add_argument("--profile-steps", default=None,
                    help="'start:stop' global-step range to trace")
     p.add_argument("--fault-inject", default=None,

@@ -31,11 +31,13 @@ restore. TPU-native design (Orbax-style, self-contained implementation):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
 import threading
 import time
+import zlib
 from typing import Any
 
 import jax
@@ -43,12 +45,40 @@ import numpy as np
 
 from pytorch_distributed_training_example_tpu.core import distributed
 from pytorch_distributed_training_example_tpu.parallel.sharding import param_path
+from pytorch_distributed_training_example_tpu.utils import resilience
+
+log = logging.getLogger("pdtx")
 
 COMMIT_FILE = "COMMIT"
 MANIFEST_FILE = "manifest.json"
 SAVING_SUFFIX = ".saving"  # in-progress attempt dirs (never resume-eligible)
 OLD_SUFFIX = ".old"  # prior committed dir set aside during a re-save swap
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint save failed (surfaced by :meth:`Checkpointer.wait`)."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A committed checkpoint failed integrity verification on restore."""
+
+
+def _file_crc32(path: str) -> int:
+    """Streaming CRC32 of a file's bytes (1 MB chunks).
+
+    File-level (includes the npy header), streamed so integrity verification
+    never materializes a full leaf — restore's peak-host-memory contract is
+    one SHARD (see ``_assemble_sharded``), and checksumming must not be the
+    thing that breaks it.
+    """
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(chunk, crc)
 
 
 def _is_array_leaf(x) -> bool:
@@ -72,6 +102,10 @@ class Checkpointer:
         self.directory = directory
         self.keep = keep
         self._thread: threading.Thread | None = None
+        self._error: tuple[int, BaseException] | None = None
+        #: Step actually restored by the last ``restore()`` call — the caller
+        #: asked for "latest usable", this is which one survived verification.
+        self.last_restored_step: int | None = None
         if distributed.is_main_process():
             os.makedirs(directory, exist_ok=True)
             self._recover_interrupted_replace()
@@ -203,8 +237,16 @@ class Checkpointer:
                 safe = path.replace("/", ".")
                 for i, (idx, data) in enumerate(regions):
                     fname = f"{safe}.p{jax.process_index()}.{i}.npy"
-                    np.save(os.path.join(arrays_dir, fname), data)
-                    written.setdefault(path, []).append({"file": fname, "index": idx})
+                    fpath = os.path.join(arrays_dir, fname)
+                    resilience.retriable_io(np.save, fpath, data,
+                                            _what="ckpt_write")
+                    # Checksum recorded in the manifest, verified by restore.
+                    # Computed right after the write (page-cache hot), over
+                    # the file bytes — so restore verifies exactly what the
+                    # filesystem durably holds, npy header included.
+                    written.setdefault(path, []).append({
+                        "file": fname, "index": idx,
+                        "crc32": _file_crc32(fpath)})
             if multihost:
                 # Per-host file list doubles as the "this host is done"
                 # sentinel: written ATOMICALLY (tmp+rename) after the arrays
@@ -217,12 +259,10 @@ class Checkpointer:
                 os.replace(flist + ".tmp", flist)
             if distributed.is_main_process():
                 if multihost and not self._await_hosts(attempt_dir, nproc):
-                    import logging
-
                     # A host died or stalled mid-save: leave uncommitted,
                     # but NEVER silently — the operator must know --resume
                     # will fall back to an older step.
-                    logging.getLogger(__name__).error(
+                    log.error(
                         "checkpoint step %d NOT committed: not every host "
                         "finished writing within the timeout (attempt left "
                         "at %s)", step, attempt_dir)
@@ -237,8 +277,13 @@ class Checkpointer:
                 }
                 # NOTE: multi-host file listings are per-host in files.p*.json;
                 # restore unions them with the manifest's own list.
-                with open(os.path.join(attempt_dir, MANIFEST_FILE), "w") as fh:
-                    json.dump(manifest, fh)
+                def write_json(path, obj):
+                    with open(path, "w") as fh:
+                        json.dump(obj, fh)
+
+                resilience.retriable_io(
+                    write_json, os.path.join(attempt_dir, MANIFEST_FILE),
+                    manifest, _what="ckpt_write")
                 # COMMIT is written INSIDE the attempt dir (whose .saving
                 # suffix keeps it resume-ineligible), so the rename below
                 # publishes a fully-committed dir in one atomic syscall.
@@ -260,9 +305,25 @@ class Checkpointer:
 
         # attempt dir + rename + COMMIT marker is the atomicity boundary
         if block:
-            write()
+            try:
+                write()
+            except Exception as e:
+                raise CheckpointWriteError(
+                    f"checkpoint save for step {step} failed: "
+                    f"{type(e).__name__}: {e}") from e
         else:
-            self._thread = threading.Thread(target=write, daemon=True)
+            def guarded():
+                try:
+                    write()
+                except BaseException as e:  # noqa: BLE001 — surfaced by wait()
+                    # A failed background save must NOT die silently with the
+                    # daemon thread: the trainer would believe the step is
+                    # durable. Stash it; wait() re-raises on the main thread.
+                    self._error = (step, e)
+                    log.error("background checkpoint write for step %d "
+                              "failed: %s: %s", step, type(e).__name__, e)
+
+            self._thread = threading.Thread(target=guarded, daemon=True)
             self._thread.start()
 
     def _await_hosts(self, step_dir: str, nproc: int,
@@ -279,9 +340,37 @@ class Checkpointer:
         return False
 
     def wait(self):
+        """Join the in-flight background save, RE-RAISING its failure.
+
+        Before this, a failed background write vanished with its daemon
+        thread and the trainer believed the step was durable. Raises
+        :class:`CheckpointWriteError` (chained to the original) so callers
+        can log-and-retry; the stashed error is cleared once raised.
+        """
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._error is not None:
+            (step, err), self._error = self._error, None
+            raise CheckpointWriteError(
+                f"background checkpoint write for step {step} failed: "
+                f"{type(err).__name__}: {err}") from err
+
+    def quarantine(self, step: int, reason: str = "poisoned") -> None:
+        """Set a committed checkpoint aside, permanently resume-ineligible.
+
+        Renamed (not deleted) so the bad state stays inspectable; the suffix
+        makes the name fail ``_STEP_RE``, so every discovery path ignores it.
+        Used by anomaly rollback when a checkpoint saved after a poisoned
+        batch itself contains non-finite params — left in place it would be
+        exactly what a later ``--resume auto`` restores.
+        """
+        src = os.path.join(self.directory, f"step_{step:08d}")
+        dst = f"{src}.{reason}"
+        if os.path.isdir(src):
+            shutil.rmtree(dst, ignore_errors=True)
+            os.rename(src, dst)
+            log.warning("checkpoint step %d quarantined -> %s", step, dst)
 
     def _prune(self):
         steps = sorted(all_checkpoints(self.directory))
@@ -308,14 +397,55 @@ class Checkpointer:
         with a matching shape — resuming is all-or-nothing, because training
         or evaluating a half-initialized model is silent garbage.
         ``allow_partial=True`` downgrades mismatches to a warning (surgical
-        transfer-learning loads)."""
-        if step is None:
-            step = latest_checkpoint(self.directory)
-            if step is None:
-                raise FileNotFoundError(f"no committed checkpoint in {self.directory}")
+        transfer-learning loads).
+
+        With ``step=None`` ("latest usable"): committed steps are tried
+        newest-first, and one whose manifest is missing/unparseable or whose
+        files fail CRC verification is SKIPPED with a loud warning — a
+        corrupted latest checkpoint costs the steps since the previous save,
+        not the whole run. An explicit ``step`` is restored exactly or raises.
+        ``self.last_restored_step`` records which step actually loaded.
+        """
+        if step is not None:
+            out = self._restore_step(state_template, step, allow_partial)
+            self.last_restored_step = step
+            return out
+        candidates = sorted(all_checkpoints(self.directory), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no committed checkpoint in {self.directory}")
+        last_err: BaseException | None = None
+        for cand in candidates:
+            try:
+                out = self._restore_step(state_template, cand, allow_partial)
+            except (CheckpointCorruptError, OSError,
+                    json.JSONDecodeError, KeyError) as e:
+                log.error(
+                    "checkpoint step %d is unusable (%s: %s) — falling back "
+                    "to the previous committed step", cand,
+                    type(e).__name__, e)
+                last_err = e
+                continue
+            if cand != candidates[0]:
+                log.warning(
+                    "restored step %d instead of latest committed step %d "
+                    "(newer checkpoint(s) failed integrity checks)",
+                    cand, candidates[0])
+            self.last_restored_step = cand
+            return out
+        raise CheckpointCorruptError(
+            f"every committed checkpoint in {self.directory} "
+            f"({candidates}) failed to restore") from last_err
+
+    def _restore_step(self, state_template, step: int,
+                      allow_partial: bool = False):
         step_dir = os.path.join(self.directory, f"step_{step:08d}")
-        with open(os.path.join(step_dir, MANIFEST_FILE)) as fh:
-            manifest = json.load(fh)
+
+        def read_manifest():
+            with open(os.path.join(step_dir, MANIFEST_FILE)) as fh:
+                return json.load(fh)
+
+        manifest = resilience.retriable_io(read_manifest, _what="ckpt_read")
         # Union per-host file lists when present (multi-host shared fs).
         leaves = manifest["leaves"]
         for fn in os.listdir(step_dir):
@@ -328,6 +458,29 @@ class Checkpointer:
 
         arrays_dir = os.path.join(step_dir, "arrays")
         flat_template = _flatten(state_template)
+
+        # Integrity pre-pass: verify the recorded CRC32 of every file this
+        # restore will read, BEFORE any assembly — a bitflip or truncation
+        # must surface as CheckpointCorruptError (fallback-eligible), never
+        # as silent garbage weights or an np.load crash mid-assembly.
+        # Entries without a checksum (pre-integrity checkpoints) are skipped.
+        checked: set[str] = set()
+        for path, meta in leaves.items():
+            if path not in flat_template:
+                continue
+            for entry in meta["files"]:
+                fname = entry["file"]
+                if "crc32" not in entry or fname in checked:
+                    continue
+                checked.add(fname)
+                fpath = os.path.join(arrays_dir, fname)
+                got = resilience.retriable_io(_file_crc32, fpath,
+                                              _what="ckpt_read")
+                if got != entry["crc32"]:
+                    raise CheckpointCorruptError(
+                        f"CRC mismatch in {fpath!r}: manifest says "
+                        f"{entry['crc32']:#010x}, file has {got:#010x} "
+                        f"(size {os.path.getsize(fpath)} bytes)")
 
         restored: dict[str, Any] = {}
         shape_mismatch: list[str] = []
@@ -479,6 +632,29 @@ def all_checkpoints(directory: str) -> list[int]:
     return sorted(out)
 
 
+def _manifest_ok(directory: str, step: int) -> bool:
+    """True when the committed step's manifest exists and parses."""
+    try:
+        with open(os.path.join(directory, f"step_{step:08d}",
+                               MANIFEST_FILE)) as fh:
+            json.load(fh)
+        return True
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
 def latest_checkpoint(directory: str) -> int | None:
+    """Newest committed step whose manifest is present and parseable.
+
+    A COMMIT marker over a missing/garbled manifest (torn write, partial
+    sync) previously made ``--resume auto`` crash with a raw JSONDecodeError;
+    such a dir is treated as uncommitted and skipped with a warning.
+    """
     steps = all_checkpoints(directory)
-    return steps[-1] if steps else None
+    for s in reversed(steps):
+        if _manifest_ok(directory, s):
+            return s
+        log.warning(
+            "checkpoint step %d in %s has a missing/unparseable manifest — "
+            "treating as uncommitted and falling back", s, directory)
+    return None

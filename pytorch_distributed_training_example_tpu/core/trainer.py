@@ -10,6 +10,7 @@ are fetched every ``log_every`` steps.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import tempfile
 import time
@@ -34,7 +35,9 @@ from pytorch_distributed_training_example_tpu.data import (
 )
 from pytorch_distributed_training_example_tpu.models import registry
 from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
+from pytorch_distributed_training_example_tpu.utils import chaos as chaos_lib
 from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
+from pytorch_distributed_training_example_tpu.utils import resilience
 from pytorch_distributed_training_example_tpu.utils import telemetry as telemetry_lib
 from pytorch_distributed_training_example_tpu.utils import watchdog as watchdog_lib
 from pytorch_distributed_training_example_tpu.utils.config import Config
@@ -67,6 +70,20 @@ class Trainer:
                 allow_scaler_skips=(cfg.precision == "fp16"))
             log.info("telemetry on: health pack in metrics, spans/goodput/"
                      "anomaly bundles -> %s", tdir)
+
+        # Chaos harness (utils/chaos.py): armed BEFORE the workload builds so
+        # the loader batch hook is installed before any batch is yielded.
+        self._chaos: chaos_lib.ChaosEngine | None = None
+        if cfg.chaos:
+            self._chaos = chaos_lib.ChaosEngine(
+                cfg.chaos,
+                seed=(cfg.chaos_seed if cfg.chaos_seed is not None
+                      else cfg.seed),
+                log_dir=cfg.checkpoint_dir)
+            loader_lib.set_batch_hook(self._chaos.batch_hook)
+            log.warning("chaos harness armed: %s (seed %d)", cfg.chaos,
+                        self._chaos.seed)
+        self._rollbacks = 0
 
         init_span = self._span("init")
         init_span.__enter__()
@@ -145,6 +162,10 @@ class Trainer:
             self.steps_per_epoch = min(self.steps_per_epoch, cfg.steps_per_epoch)
         # epoch-keyed eval rows land on the global-step TensorBoard axis
         self.metric_logger.steps_per_epoch = self.steps_per_epoch
+        if self._chaos is not None:
+            # Batch-site chaos events key on the same global index as the
+            # step-site ones: epoch * steps_per_epoch + batch.
+            self._chaos.steps_per_epoch = self.steps_per_epoch
 
         # optimizer / state ------------------------------------------------
         self.tx, self.schedule = optim.build_optimizer(cfg, self.steps_per_epoch)
@@ -249,13 +270,15 @@ class Trainer:
                     f"--resume path not found: {self.cfg.resume}")
             if directory != self.checkpointer.directory:
                 self.checkpointer = checkpoint_lib.Checkpointer(directory)
-        if step is None:
-            step = checkpoint_lib.latest_checkpoint(directory)
-            if step is None:
-                log.info("resume requested but no committed checkpoint in %s", directory)
-                return
+        if step is None and not checkpoint_lib.all_checkpoints(directory):
+            log.info("resume requested but no committed checkpoint in %s", directory)
+            return
+        # step=None lets restore() pick the newest USABLE step: a corrupted
+        # or manifest-less latest checkpoint falls back to the previous
+        # committed one (with a loud warning) instead of crashing the resume.
         with self._span("checkpoint_restore"):
             self.state, extra = self.checkpointer.restore(self.state, step)
+        step = self.checkpointer.last_restored_step
         epoch = int(extra.get("epoch", -1))
         # Epoch-boundary checkpoints carry no step_offset (the epoch is
         # complete); mid-epoch ones record how many steps of `epoch` were
@@ -294,7 +317,8 @@ class Trainer:
             log.info("resumed from step %d (epoch %d)", step, self.start_epoch)
         self.resumed = True
 
-    def _save(self, epoch: int, step_offset: int | None = None):
+    def _save(self, epoch: int, step_offset: int | None = None,
+              block: bool = False):
         if self.checkpointer is None:
             return
         step = int(jax.device_get(self.state.step))
@@ -309,24 +333,147 @@ class Trainer:
                  "steps_per_epoch": self.steps_per_epoch}
         if step_offset is not None:
             extra["step_offset"] = step_offset
-        with self._span("checkpoint_save"):
-            self.checkpointer.save(self.state, step, extra=extra)
+        # One retry: save() first joins the previous background write, so a
+        # CheckpointWriteError here may be THAT save's failure surfacing —
+        # either way the right response is to try writing the current state
+        # once more, then let a persistent failure propagate.
+        for attempt in (1, 2):
+            try:
+                with self._span("checkpoint_save"):
+                    if self._chaos is not None:
+                        self._chaos.before_save()
+                    self.checkpointer.save(self.state, step, extra=extra,
+                                           block=block)
+                    if self._chaos is not None:
+                        self._chaos.after_save(self.checkpointer)
+                break
+            except checkpoint_lib.CheckpointWriteError as e:
+                if attempt == 2:
+                    raise
+                log.error("checkpoint save for step %d failed (%s) — "
+                          "retrying once", step, e)
         self._last_saved_step = step
+
+    # -- resilience --------------------------------------------------------
+
+    def _graceful_shutdown(self, epoch: int, step_offset: int):
+        """Act on a preemption signal at a step/epoch boundary: make the
+        current state durable, then exit with the distinct preemption code.
+
+        Raises :class:`resilience.PreemptedExit` (a SystemExit), so
+        ``train()``'s finally still emits the telemetry goodput summary and
+        closes the metric logger on the way out; a supervisor
+        (``launch.py --restart-policy``) relaunches ``--resume auto`` on
+        :data:`resilience.PREEMPTED_EXIT_CODE`.
+        """
+        log.warning(
+            "preemption (signal %s): emergency checkpoint at epoch %d step "
+            "offset %d, then exit %d", resilience.preempt_signal(), epoch,
+            step_offset, resilience.PREEMPTED_EXIT_CODE)
+        if self.checkpointer is not None:
+            try:
+                self.checkpointer.wait()  # join any in-flight background save
+            except checkpoint_lib.CheckpointWriteError as e:
+                # That save never committed — its step id must not dedupe
+                # the emergency save below.
+                log.error("in-flight save failed during shutdown (%s)", e)
+                self._last_saved_step = -1
+            self._save(epoch, step_offset=step_offset, block=True)
+            log.warning("emergency checkpoint committed — exiting")
+        raise resilience.PreemptedExit()
+
+    def _anomaly_rollback(self, epoch: int, i: int) -> int:
+        """``anomaly_action="rollback"``: restore the last committed
+        checkpoint and return the batch index to continue from.
+
+        The poisoned batch was consumed exactly once (its update is being
+        discarded with the restore), so continuing at ``i + 1`` keeps the
+        run's yielded-index log identical to an uninterrupted run's.
+        Escalates to :class:`AnomalyError` once ``rollback_budget`` is
+        exhausted or when there is nothing to restore — a model that keeps
+        going non-finite after restores has a real problem, not a blip.
+        """
+        cfg = self.cfg
+        self._rollbacks += 1
+        if self._rollbacks > cfg.rollback_budget:
+            raise telemetry_lib.AnomalyError(
+                f"anomaly rollback budget exhausted "
+                f"({cfg.rollback_budget}): still hitting non-finite health "
+                f"scalars after {cfg.rollback_budget} restore(s) — aborting")
+        if self.checkpointer is None:
+            raise telemetry_lib.AnomalyError(
+                "anomaly_action=rollback needs --checkpoint-dir (nothing "
+                "to restore from)")
+        try:
+            self.checkpointer.wait()  # don't race an in-flight save
+        except checkpoint_lib.CheckpointWriteError as e:
+            log.error("in-flight save failed before rollback (%s)", e)
+            self._last_saved_step = -1
+        # Newest-first over committed steps, VALIDATING each restored state:
+        # a step-cadence save that landed at/after the poisoned batch is
+        # committed and CRC-clean yet contains non-finite params — restoring
+        # it would just re-trip the guard until the budget aborts. Such a
+        # checkpoint is quarantined so a later --resume cannot pick it either.
+        restored_step = None
+        for cand in sorted(checkpoint_lib.all_checkpoints(
+                self.checkpointer.directory), reverse=True):
+            try:
+                with self._span("checkpoint_restore"):
+                    state, _ = self.checkpointer.restore(self.state, cand)
+            except (checkpoint_lib.CheckpointCorruptError, OSError,
+                    json.JSONDecodeError, KeyError) as e:
+                log.error("rollback: checkpoint step %d unusable (%s: %s) — "
+                          "trying an older one", cand, type(e).__name__, e)
+                continue
+            if all(bool(jnp.isfinite(x).all())
+                   for x in jax.tree.leaves(state.params)):
+                self.state = state
+                restored_step = cand
+                break
+            log.warning(
+                "rollback: checkpoint step %d itself has non-finite params "
+                "(saved after the poisoned batch) — quarantining and trying "
+                "an older one", cand)
+            if distributed.is_main_process():
+                self.checkpointer.quarantine(cand)
+        if restored_step is None:
+            raise telemetry_lib.AnomalyError(
+                "anomaly_action=rollback: no committed checkpoint with "
+                "finite params to restore")
+        log.warning(
+            "anomaly rollback %d/%d: restored step %d, continuing at epoch "
+            "%d batch %d", self._rollbacks, cfg.rollback_budget,
+            restored_step, epoch, i + 1)
+        # The restored optimizer step count will re-pass ids the cadence
+        # already saved; clear the dedupe so those saves are not skipped.
+        self._last_saved_step = -1
+        return i + 1
 
     # -- loops -------------------------------------------------------------
 
     def train(self):
         cfg = self.cfg
+        # Preemption-safe shutdown: SIGTERM/SIGINT only set a flag here; the
+        # step loop polls it at step boundaries and runs _graceful_shutdown
+        # (finish in-flight step -> blocking emergency checkpoint -> goodput
+        # emit via the finally below -> exit PREEMPTED_EXIT_CODE). No-op off
+        # the main thread (install() warns and returns False).
+        resilience.install()
         # One run-level watchdog spanning train AND eval (both loops beat it,
         # so a long eval never false-triggers); its timeout dump carries the
         # telemetry snapshot — last step, last health row, goodput — when on.
         self._watchdog = watchdog_lib.Watchdog(
-            timeout_s=1800,
+            timeout_s=cfg.watchdog_timeout,
             context_fn=(self.telemetry.snapshot
                         if self.telemetry is not None else None)).start()
         try:
             for epoch in range(self.start_epoch, cfg.epochs):
                 self.train_epoch(epoch)
+                if resilience.preempted():
+                    # Tripped during the epoch's tail or between loops (e.g.
+                    # mid-eval next iteration): the epoch is complete, so the
+                    # emergency save is an epoch-boundary one.
+                    self._graceful_shutdown(epoch, self.steps_per_epoch)
                 if (epoch + 1) % cfg.eval_every_epochs == 0:
                     self.evaluate(epoch)
                 if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
@@ -361,18 +508,17 @@ class Trainer:
         loss_m = AverageMeter("loss")
         tput = Throughput()
         t_step = time.perf_counter()
-        it = prefetch.device_prefetch(self.train_loader, self.batch_sharding)
         # train() owns the run-level watchdog; a direct train_epoch() call
         # (tests, notebooks) gets a per-epoch one with the same context hook.
         watchdog = self._watchdog
         own_watchdog = watchdog is None
         if own_watchdog:
             watchdog = watchdog_lib.Watchdog(
-                timeout_s=1800,
+                timeout_s=cfg.watchdog_timeout,
                 context_fn=(self.telemetry.snapshot
                             if self.telemetry is not None else None)).start()
         try:
-            self._train_epoch_inner(epoch, it, loss_m, tput, t_step, watchdog)
+            self._train_epoch_inner(epoch, loss_m, tput, t_step, watchdog)
         finally:
             if own_watchdog:
                 watchdog.stop()
@@ -382,9 +528,21 @@ class Trainer:
                 log.warning("native loader: %d image(s) failed to decode "
                             "(zero-filled)", errs())
 
-    def _train_epoch_inner(self, epoch, it, loss_m, tput, t_step, watchdog):
+    def _make_step_iter(self, epoch, start):
+        """(Re)build the prefetched batch iterator from batch ``start``.
+
+        Separate from the epoch loop so the anomaly-rollback path can tear
+        the pipeline down and rebuild it past the poisoned batch window —
+        the loader's index stream is a pure function of (seed, epoch, start),
+        so this is sample-exact.
+        """
+        self.train_loader.start_batch = start
+        return prefetch.device_prefetch(self.train_loader, self.batch_sharding)
+
+    def _train_epoch_inner(self, epoch, loss_m, tput, t_step, watchdog):
         cfg = self.cfg
         tele = self.telemetry
+        it = self._make_step_iter(epoch, self.train_loader.start_batch)
         with mesh_lib.use_mesh(self.mesh):
             i = self.train_loader.start_batch
             while i < self.steps_per_epoch:
@@ -418,14 +576,6 @@ class Trainer:
                 else:
                     with self._span("step"):
                         self.state, metrics = self.train_step(self.state, batch)
-                if (cfg.checkpoint_every_steps
-                        and (gstep + 1) % cfg.checkpoint_every_steps == 0):
-                    # Step-cadence save: records (epoch, steps applied) so
-                    # resume fast-forwards to the exact next sample. Runs
-                    # even at the epoch boundary — eval may take a long
-                    # time, and the boundary state must be durable before
-                    # it; the per-epoch save then dedupes on step id.
-                    self._save(epoch, step_offset=i + 1)
                 if self.profile_range and gstep + 1 == self.profile_range[1]:
                     jax.tree.map(lambda x: x.block_until_ready(), metrics)
                     jax.profiler.stop_trace()
@@ -444,10 +594,28 @@ class Trainer:
                     if tele is not None:
                         # May raise AnomalyError (anomaly_action="abort")
                         # after writing the diagnostic bundle.
-                        tele.observe(gstep, {"epoch": epoch, **m})
+                        tripped = tele.observe(gstep, {"epoch": epoch, **m})
+                        if tripped and cfg.anomaly_action == "rollback":
+                            it.close()
+                            i = self._anomaly_rollback(epoch, i)
+                            it = self._make_step_iter(epoch, i)
+                            t_step = time.perf_counter()
+                            continue
                     if not is_log:
                         self.metric_logger.write(kind="health", epoch=epoch,
                                                  step=gstep, **m)
+                if (cfg.checkpoint_every_steps
+                        and (gstep + 1) % cfg.checkpoint_every_steps == 0):
+                    # Step-cadence save: records (epoch, steps applied) so
+                    # resume fast-forwards to the exact next sample. Runs
+                    # even at the epoch boundary — eval may take a long
+                    # time, and the boundary state must be durable before
+                    # it; the per-epoch save then dedupes on step id.
+                    # AFTER the health fetch above: a state the anomaly
+                    # guard just flagged (rollback `continue`d, abort
+                    # raised) must never be the checkpoint a restart
+                    # resumes into.
+                    self._save(epoch, step_offset=i + 1)
                 if is_log:
                     loss_m.update(m["loss"])
                     lr = float(self.schedule(gstep))
@@ -466,6 +634,13 @@ class Trainer:
                     )
                     self.metric_logger.write(kind="train", epoch=epoch, step=gstep,
                                              lr=lr, rate=rate, mfu=mfu, **m)
+                if self._chaos is not None:
+                    self._chaos.step_boundary(gstep)
+                # Preemption poll — the ONLY place the SIGTERM flag is acted
+                # on, so the in-flight step always completes first and the
+                # emergency checkpoint is taken at a clean step boundary.
+                if resilience.preempted():
+                    self._graceful_shutdown(epoch, i + 1)
                 i += 1
 
     def evaluate(self, epoch: int):

@@ -28,6 +28,22 @@ from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
 # jax reports more than one process.
 INDEX_LOG_ENV = "PDTX_INDEX_LOG"
 
+# Process-wide yield-time hook: ``hook(epoch, batch_idx, batch) -> batch``,
+# applied by every loader (python and native paths) right after index
+# logging. The chaos harness (utils/chaos.py) uses it to poison or stall
+# specific batches deterministically — keyed on the batch INDEX, so prefetch
+# lookahead does not shift which batch gets hit.
+_batch_hook = None
+
+
+def set_batch_hook(fn) -> None:
+    global _batch_hook
+    _batch_hook = fn
+
+
+def _apply_batch_hook(epoch: int, batch: int, item):
+    return _batch_hook(epoch, batch, item) if _batch_hook is not None else item
+
 
 def _log_indices(epoch: int, batch: int, indices) -> None:
     path = os.environ.get(INDEX_LOG_ENV)
@@ -151,7 +167,8 @@ class DataLoader:
         if self.num_workers <= 0:
             for b, indices in enumerate(self._batches_of_indices(start), start):
                 _log_indices(self.sampler.epoch, b, indices)
-                yield self._make_batch(indices)
+                yield _apply_batch_hook(self.sampler.epoch, b,
+                                        self._make_batch(indices))
             return
         yield from self._threaded_iter(start)
 
@@ -187,7 +204,7 @@ class DataLoader:
                     raise RuntimeError(
                         f"DataLoader worker failed on batch {b}") from item.exc
                 _log_indices(self.sampler.epoch, start + b, index_batches[b])
-                yield item
+                yield _apply_batch_hook(self.sampler.epoch, start + b, item)
                 budget.release()
         finally:
             stop.set()

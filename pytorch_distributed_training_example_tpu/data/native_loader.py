@@ -315,7 +315,7 @@ class NativeDataLoader:
                 if b + inflight < nb:
                     submit(b + inflight)
                 loader_lib._log_indices(self.epoch, b, bi)
-                yield batch
+                yield loader_lib._apply_batch_hook(self.epoch, b, batch)
         finally:
             # Drain in-flight jobs before `bufs` can be garbage-collected:
             # abandoned C++ jobs hold raw pointers into them (use-after-free

@@ -1,0 +1,217 @@
+"""Deterministic chaos harness: seeded fault injection at named sites.
+
+Every recovery path in the resilience layer (``utils/resilience.py``,
+``core/checkpoint.py`` integrity + fallback, the trainer's anomaly rollback)
+is exercised end-to-end by injecting faults from INSIDE a real training run,
+rather than trusted on inspection. ``--chaos`` takes a comma-separated spec:
+
+    sigterm@step=7         deliver SIGTERM to this process at the end of
+                           global step 7 (the preemption drill)
+    sigint@step=7          same, with SIGINT
+    nan_grad@step=5        poison the batch consumed at global step 5 (float
+                           inputs overwritten with NaN -> non-finite health
+                           scalars -> anomaly guard)
+    loader_stall@batch=3   sleep ``STALL_S`` before yielding global batch 3
+                           (shows up in the input_wait badput bucket)
+    ckpt_io_error@save=2   inject OSError into the first ``IO_FAILURES``
+                           filesystem ops of the 2nd checkpoint save (1-based)
+                           — exercises the retriable-io backoff path
+    truncate_ckpt[@save=1] after the K-th save commits, truncate one array
+                           file of the newest committed checkpoint (the CRC
+                           fallback-restore drill; file choice is seeded)
+
+Counters are GLOBAL (step/batch indices are ``epoch * steps_per_epoch + i``;
+save counts every ``Checkpointer.save`` call this process makes), and every
+event fires at most once per process — a run resumed past the trip point
+does not re-trip, which is what lets the supervisor restart converge.
+
+Determinism: the spec + seed fully determine what fires where; the only
+randomness (truncation target choice) draws from a ``RandomState(seed)``.
+Each injection appends one JSON line to ``<log_dir>/chaos.jsonl`` so two runs
+with the same spec and seed can be diffed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import time
+
+import numpy as np
+
+from pytorch_distributed_training_example_tpu.utils import resilience
+
+log = logging.getLogger("pdtx")
+
+CHAOS_LOG = "chaos.jsonl"
+
+#: Sites and the counter key each one fires on (None = optional, default 1).
+_SITES = {
+    "sigterm": "step",
+    "sigint": "step",
+    "nan_grad": "step",
+    "loader_stall": "batch",
+    "ckpt_io_error": "save",
+    "truncate_ckpt": "save",
+}
+
+
+@dataclasses.dataclass
+class _Event:
+    name: str
+    key: str
+    value: int
+    fired: bool = False
+
+
+def parse_spec(spec: str) -> list[_Event]:
+    """Parse ``name@key=value,...`` into events; raises ValueError on junk."""
+    events = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        name, _, cond = raw.partition("@")
+        if name not in _SITES:
+            raise ValueError(
+                f"unknown chaos event {name!r} in {spec!r}; "
+                f"have {sorted(_SITES)}")
+        want_key = _SITES[name]
+        if cond:
+            key, _, val = cond.partition("=")
+            if key != want_key or not val.lstrip("-").isdigit():
+                raise ValueError(
+                    f"chaos event {raw!r}: expected {name}@{want_key}=<int>")
+            value = int(val)
+        elif name == "truncate_ckpt":
+            value = 1  # default: corrupt the first committed save
+        else:
+            raise ValueError(
+                f"chaos event {raw!r} needs @{want_key}=<int>")
+        events.append(_Event(name, want_key, value))
+    if not events:
+        raise ValueError(f"empty chaos spec {spec!r}")
+    return events
+
+
+class ChaosEngine:
+    """Holds the parsed spec and fires events at the named sites.
+
+    The trainer wires the sites: ``step_boundary`` after each optimizer step,
+    ``batch_hook`` installed as the loader's yield-time hook
+    (``data/loader.py``), ``before_save``/``after_save`` around every
+    ``Checkpointer.save``.
+    """
+
+    IO_FAILURES = 2   # < retriable_io's default 4 attempts: retry succeeds
+    STALL_S = 1.0
+
+    def __init__(self, spec: str, seed: int = 0, log_dir: str | None = None):
+        self.events = parse_spec(spec)
+        self.seed = seed
+        self.rng = np.random.RandomState(seed)
+        self.log_path = (os.path.join(log_dir, CHAOS_LOG)
+                         if log_dir else None)
+        # Set by the trainer so batch-site events can map (epoch, batch) to
+        # a global index consistent with the step numbering.
+        self.steps_per_epoch: int | None = None
+        self._saves = 0
+        self._io_faults_left = 0
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _take(self, name: str, value: int) -> _Event | None:
+        for ev in self.events:
+            if ev.name == name and ev.value == value and not ev.fired:
+                ev.fired = True
+                return ev
+        return None
+
+    def _record(self, ev: _Event, **detail) -> None:
+        row = {"event": ev.name, ev.key: ev.value, "seed": self.seed, **detail}
+        log.warning("chaos: injecting %s", row)
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            with open(self.log_path, "a") as fh:
+                fh.write(json.dumps(row) + "\n")
+
+    # -- sites --------------------------------------------------------------
+
+    def step_boundary(self, gstep: int) -> None:
+        """End of global step ``gstep`` (trainer loop, after the dispatch)."""
+        for name, sig in (("sigterm", signal.SIGTERM),
+                          ("sigint", signal.SIGINT)):
+            ev = self._take(name, gstep)
+            if ev is not None:
+                self._record(ev, pid=os.getpid())
+                # A REAL signal through the real delivery path — the
+                # resilience handler, not a shortcut to its flag.
+                os.kill(os.getpid(), sig)
+
+    def batch_hook(self, epoch: int, batch_idx: int, batch: dict) -> dict:
+        """Loader yield-time hook (``data/loader.py`` ``set_batch_hook``)."""
+        g = batch_idx
+        if self.steps_per_epoch:
+            g = epoch * self.steps_per_epoch + batch_idx
+        ev = self._take("loader_stall", g)
+        if ev is not None:
+            self._record(ev, stall_s=self.STALL_S)
+            time.sleep(self.STALL_S)
+        ev = self._take("nan_grad", g)
+        if ev is not None:
+            float_keys = [k for k, v in batch.items()
+                          if np.issubdtype(np.asarray(v).dtype, np.floating)]
+            if not float_keys:
+                raise ValueError(
+                    "nan_grad chaos needs a float input array to poison; "
+                    f"batch has only {sorted(batch)} "
+                    "(integer token batches cannot carry NaN)")
+            self._record(ev, poisoned=sorted(float_keys))
+            batch = dict(batch)
+            for k in float_keys:
+                batch[k] = np.full_like(np.asarray(batch[k]), np.nan)
+        return batch
+
+    def before_save(self) -> None:
+        """Called before every ``Checkpointer.save`` this process issues."""
+        self._saves += 1
+        ev = self._take("ckpt_io_error", self._saves)
+        if ev is not None:
+            self._record(ev, io_failures=self.IO_FAILURES)
+            self._io_faults_left = self.IO_FAILURES
+            resilience.set_fault_hook(self._io_fault)
+
+    def _io_fault(self, what: str) -> None:
+        if self._io_faults_left > 0:
+            self._io_faults_left -= 1
+            if self._io_faults_left == 0:
+                resilience.set_fault_hook(None)
+            raise OSError(f"chaos: injected checkpoint io error [{what}]")
+
+    def after_save(self, checkpointer) -> None:
+        """Called after every save; corrupts the newest committed checkpoint
+        when a ``truncate_ckpt`` event targets this save index."""
+        from pytorch_distributed_training_example_tpu.core import (
+            checkpoint as checkpoint_lib)
+
+        ev = self._take("truncate_ckpt", self._saves)
+        if ev is None:
+            return
+        checkpointer.wait()  # the targeted save may still be in flight
+        step = checkpoint_lib.latest_checkpoint(checkpointer.directory)
+        if step is None:
+            log.warning("chaos: truncate_ckpt armed but no committed "
+                        "checkpoint exists — nothing to corrupt")
+            return
+        arrays_dir = os.path.join(checkpointer.directory,
+                                  f"step_{step:08d}", "arrays")
+        files = sorted(os.listdir(arrays_dir))
+        target = files[int(self.rng.randint(len(files)))]
+        path = os.path.join(arrays_dir, target)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+        self._record(ev, step=step, file=target, orig_bytes=size)

@@ -88,8 +88,20 @@ class Config:
     # JSONL rows between the train rows)
     health_every: int = 0
     # on a non-finite health scalar: dump a diagnostic bundle then
-    # "abort" (raise) | "continue" (log and keep training)
+    # "abort" (raise) | "continue" (log and keep training) | "rollback"
+    # (restore the last committed checkpoint and continue past the poisoned
+    # batch window — Switch-Transformer-style instability recovery)
     anomaly_action: str = "abort"
+    # rollback restores allowed per run before escalating to abort (a model
+    # that keeps diverging after N restores has a real problem, not a blip)
+    rollback_budget: int = 3
+    # watchdog: seconds without step progress before dumping stacks/aborting
+    # (utils/watchdog.py; was hardcoded at 1800)
+    watchdog_timeout: float = 1800.0
+    # deterministic fault injection (utils/chaos.py): comma-separated spec,
+    # e.g. "sigterm@step=7,ckpt_io_error@save=2" — None disables
+    chaos: str | None = None
+    chaos_seed: int | None = None  # defaults to `seed` when unset
     # profiling
     profile_steps: str | None = None  # "start:stop" step range
     profile_dir: str = "/tmp/pdtx_profile"

@@ -1,0 +1,157 @@
+"""Preemption-safe shutdown + retriable filesystem I/O.
+
+The TPU recovery model this repo targets (``utils/watchdog.py`` docstring,
+SURVEY.md §7(b)) is "gang-scheduled slices get preempted and restart from the
+latest checkpoint". Cloud TPU preemption is delivered as SIGTERM with a grace
+window — before this module, a SIGTERM mid-epoch simply killed the process and
+every optimizer step since the last checkpoint cadence was lost.
+
+Two pieces:
+
+1. **Preemption handler**: :func:`install` registers a SIGTERM/SIGINT handler
+   that only sets a flag. The train loop polls :func:`preempted` at step
+   boundaries (``core/trainer.py``); on trip it finishes the in-flight step,
+   takes a *blocking* emergency checkpoint, emits the telemetry goodput
+   summary (the trainer's shutdown path), and exits with
+   :data:`PREEMPTED_EXIT_CODE` — distinct from crash codes so a supervisor
+   (``launch.py --restart-policy``) can relaunch ``--resume auto`` only for
+   preemptions. A second signal while the flag is already set restores the
+   previous handlers, so a third delivery force-kills a stuck shutdown.
+
+2. **Retriable I/O**: :func:`retriable_io` runs one filesystem operation with
+   bounded exponential backoff on ``OSError`` — transient NFS/GCS-fuse
+   hiccups must not lose a checkpoint. A process-wide fault hook
+   (:func:`set_fault_hook`) lets the chaos harness (``utils/chaos.py``)
+   inject deterministic failures through the exact same code path real
+   errors take.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger("pdtx")
+
+#: Exit code of a graceful preemption shutdown. 75 is EX_TEMPFAIL ("temporary
+#: failure, try again later") — the supervisor's restart predicate, and
+#: distinct from the fault injector's hard-kill (57) and ordinary crashes.
+PREEMPTED_EXIT_CODE = 75
+
+_flag = threading.Event()
+_signum: int | None = None
+_prev_handlers: dict[int, object] = {}
+
+
+class PreemptedExit(SystemExit):
+    """Raised by the trainer after the emergency checkpoint is committed."""
+
+    def __init__(self):
+        super().__init__(PREEMPTED_EXIT_CODE)
+
+
+def _handle(signum, frame):
+    global _signum
+    if _flag.is_set():
+        # Second delivery: the operator (or platform) is insisting. Restore
+        # the previous handlers so one more signal terminates immediately
+        # instead of being swallowed by a wedged graceful shutdown.
+        uninstall()
+    _signum = signum
+    _flag.set()
+
+
+def install(signals=(signal.SIGTERM, signal.SIGINT)) -> bool:
+    """Register the graceful-shutdown handler. Idempotent; main thread only.
+
+    Returns False (and leaves handlers untouched) when called off the main
+    thread — e.g. a Trainer driven from a worker thread in tests — where
+    ``signal.signal`` would raise.
+    """
+    if _prev_handlers:
+        return True
+    try:
+        for s in signals:
+            _prev_handlers[s] = signal.signal(s, _handle)
+    except ValueError:  # not the main thread
+        _prev_handlers.clear()
+        log.warning("resilience: cannot install signal handlers off the main "
+                    "thread — preemption-safe shutdown disabled")
+        return False
+    return True
+
+
+def uninstall() -> None:
+    """Restore the pre-:func:`install` handlers (tests; second-signal path)."""
+    for s, h in list(_prev_handlers.items()):
+        try:
+            signal.signal(s, h)
+        except (ValueError, TypeError):
+            pass
+    _prev_handlers.clear()
+
+
+def preempted() -> bool:
+    """True once a shutdown signal arrived; polled at step boundaries."""
+    return _flag.is_set()
+
+
+def preempt_signal() -> int | None:
+    """The signal number that tripped the flag (None if untripped)."""
+    return _signum
+
+
+def reset() -> None:
+    """Clear the flag (tests only — a real preemption is never un-asked)."""
+    global _signum
+    _flag.clear()
+    _signum = None
+
+
+def trip() -> None:
+    """Set the flag programmatically (tests / cooperative shutdown)."""
+    _flag.set()
+
+
+# ---------------------------------------------------------------------------
+# Retriable filesystem I/O.
+# ---------------------------------------------------------------------------
+
+#: When set, called as ``hook(what)`` before every retriable operation; the
+#: chaos harness raises OSError from it to exercise the retry path without
+#: touching real files.
+_fault_hook: Callable[[str], None] | None = None
+
+
+def set_fault_hook(fn: Callable[[str], None] | None) -> None:
+    global _fault_hook
+    _fault_hook = fn
+
+
+def retriable_io(fn, *args, _what: str = "io", _attempts: int = 4,
+                 _base_delay_s: float = 0.05, **kwargs):
+    """Run ``fn(*args, **kwargs)`` retrying OSError with exponential backoff.
+
+    Bounded: ``_attempts`` tries total, delays ``_base_delay_s * 2**k``
+    between them; the final failure re-raises the original error. Transient
+    shared-filesystem errors (ESTALE, EIO on NFS attribute revalidation,
+    GCS-fuse 5xx surfaced as EIO) resolve well inside this window; real
+    persistent failures still surface — loudly, after the warnings.
+    """
+    delay = _base_delay_s
+    for attempt in range(_attempts):
+        try:
+            if _fault_hook is not None:
+                _fault_hook(_what)
+            return fn(*args, **kwargs)
+        except OSError as e:
+            if attempt == _attempts - 1:
+                raise
+            log.warning(
+                "retriable io [%s] failed (%s: %s) — retry %d/%d in %.2fs",
+                _what, type(e).__name__, e, attempt + 1, _attempts - 1, delay)
+            time.sleep(delay)
+            delay *= 2

@@ -233,9 +233,10 @@ class AnomalyGuard:
                  config: Any = None, run_id: str = "",
                  goodput_fn: Callable[[], dict] | None = None,
                  allow_scaler_skips: bool = False):
-        if action not in ("abort", "continue"):
+        if action not in ("abort", "continue", "rollback"):
             raise ValueError(
-                f"anomaly_action must be 'abort' or 'continue', got {action!r}")
+                f"anomaly_action must be 'abort', 'continue' or 'rollback', "
+                f"got {action!r}")
         self.directory = directory
         self.action = action
         self.config = config
@@ -263,8 +264,10 @@ class AnomalyGuard:
                f"diagnostic bundle: {path}")
         if self.action == "abort":
             raise AnomalyError(msg)
-        log.error("anomaly guard: %s — continuing (anomaly_action=continue)",
-                  msg)
+        # "continue" and "rollback" both return True after the dump; for
+        # rollback, acting on the trip (restore + iterator re-seed + budget)
+        # is the TRAINER's job — the guard only detects and documents.
+        log.error("anomaly guard: %s — anomaly_action=%s", msg, self.action)
         return True
 
     def dump(self, step: int, row: dict, bad_keys: list[str]) -> str:

@@ -64,6 +64,14 @@ class Watchdog:
         while not self._stop.wait(min(self.timeout_s / 4, 30.0)):
             idle = time.monotonic() - self._last
             if idle > self.timeout_s:
+                # Re-check the stop flag before acting: stop() may have been
+                # called while this thread was computing `idle` (the wait()
+                # above returned False BEFORE the event was set). Without
+                # this, a clean shutdown that raced the final wait window
+                # could still dump stacks — or, with fatal=True, abort a
+                # process that was exiting normally.
+                if self._stop.is_set():
+                    return
                 log.error(
                     "watchdog: no step progress for %.0fs (timeout %.0fs) — "
                     "likely a hung collective; dumping stacks", idle, self.timeout_s)
@@ -73,35 +81,44 @@ class Watchdog:
                                   json.dumps(self.context_fn(), default=str))
                     except Exception as e:  # never let context kill the dump
                         log.error("watchdog context unavailable (%s)", e)
+                if self._stop.is_set():
+                    return
                 faulthandler.dump_traceback(file=sys.stderr)
-                if self.fatal:
+                if self.fatal and not self._stop.is_set():
                     import os
 
                     os.abort()
                 self._last = time.monotonic()  # don't spam
 
 
-def block_until_ready_with_timeout(tree, timeout_s: float = 600.0):
-    """block_until_ready that raises instead of hanging forever."""
-    done = threading.Event()
-    err: list[BaseException] = []
+def block_until_ready_with_timeout(tree, timeout_s: float = 600.0,
+                                   poll_s: float = 0.02):
+    """block_until_ready that raises instead of hanging forever.
 
-    def target():
-        try:
-            jax.tree.map(lambda x: x.block_until_ready(), tree)
-        except BaseException as e:  # surfaced to caller
-            err.append(e)
-        finally:
-            done.set()
-
-    t = threading.Thread(target=target, daemon=True)
-    t.start()
-    if not done.wait(timeout_s):
-        faulthandler.dump_traceback(file=sys.stderr)
-        raise TimeoutError(
-            f"device results not ready after {timeout_s}s — hung collective?")
-    if err:
-        raise err[0]
+    Implemented by POLLING ``jax.Array.is_ready()`` against a deadline —
+    no helper thread. The previous version parked a daemon thread inside
+    ``block_until_ready``; on timeout that thread could never be joined and
+    leaked (pinned to the hung dispatch) for the life of the process, one
+    per timed-out call. Leaves without ``is_ready`` (host numpy, python
+    scalars) are ready by definition. Once everything is ready, a real
+    ``block_until_ready`` surfaces any deferred computation error.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "is_ready")]
+    deadline = time.monotonic() + timeout_s
+    pending = leaves
+    while pending:
+        pending = [x for x in pending if not x.is_ready()]
+        if not pending:
+            break
+        if time.monotonic() > deadline:
+            faulthandler.dump_traceback(file=sys.stderr)
+            raise TimeoutError(
+                f"device results not ready after {timeout_s}s "
+                f"({len(pending)}/{len(leaves)} arrays pending) — "
+                f"hung collective?")
+        time.sleep(poll_s)
+    for x in leaves:
+        x.block_until_ready()  # raises the computation's error, if any
 
 
 def enable_nan_checks():

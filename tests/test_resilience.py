@@ -1,0 +1,451 @@
+"""Fault-tolerance layer (ISSUE r9): resilience, chaos, integrity, supervisor.
+
+Covers the acceptance scenarios end-to-end:
+- SIGTERM mid-epoch -> committed emergency checkpoint + distinct exit code
+- CRC-corrupted / truncated / manifest-less latest checkpoint -> fallback
+  restore of the previous committed step
+- injected nan_grad with --anomaly-action rollback -> restores and continues
+  sample-exact (index log identical to an uninterrupted run)
+- injected checkpoint io errors -> retriable_io retries, then succeeds
+- chaos specs are deterministic for a given (spec, seed)
+
+In-process tests exercise the modules directly; the subprocess tests run the
+real ``main.py`` CLI (and the ``launch.py`` supervisor restart loop) exactly
+as an operator would.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.core import (
+    checkpoint as ckpt_lib, mesh as mesh_lib, optim, train_loop)
+from pytorch_distributed_training_example_tpu.data.loader import INDEX_LOG_ENV
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.parallel import (
+    sharding as sharding_lib)
+from pytorch_distributed_training_example_tpu.utils import (
+    chaos as chaos_lib, resilience, watchdog)
+from pytorch_distributed_training_example_tpu.utils.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPE = 5  # steps per epoch in the subprocess drills
+
+
+# ---------------------------------------------------------------------------
+# resilience: signal flag + retriable io
+# ---------------------------------------------------------------------------
+
+
+def test_signal_sets_flag_without_exiting():
+    assert resilience.install()
+    try:
+        assert not resilience.preempted()
+        os.kill(os.getpid(), signal.SIGTERM)  # real delivery path
+        deadline = time.monotonic() + 5
+        while not resilience.preempted() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert resilience.preempted()
+        assert resilience.preempt_signal() == signal.SIGTERM
+    finally:
+        resilience.uninstall()
+        resilience.reset()
+    assert not resilience.preempted()
+
+
+def test_install_off_main_thread_is_refused():
+    result = {}
+    t = threading.Thread(target=lambda: result.update(
+        ok=resilience.install()))
+    t.start()
+    t.join()
+    assert result["ok"] is False
+    assert not resilience.preempted()
+
+
+def test_retriable_io_retries_transient_oserror():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "done"
+
+    assert resilience.retriable_io(flaky, _base_delay_s=0.001) == "done"
+    assert len(calls) == 3
+
+
+def test_retriable_io_bounded_and_reraises():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        resilience.retriable_io(broken, _attempts=3, _base_delay_s=0.001)
+    assert len(calls) == 3
+
+
+def test_fault_hook_feeds_the_retry_path():
+    state = {"faults": 2, "ran": 0}
+
+    def hook(what):
+        if state["faults"] > 0:
+            state["faults"] -= 1
+            raise OSError(f"injected [{what}]")
+
+    resilience.set_fault_hook(hook)
+    try:
+        def op():
+            state["ran"] += 1
+            return 42
+        assert resilience.retriable_io(op, _base_delay_s=0.001) == 42
+    finally:
+        resilience.set_fault_hook(None)
+    assert state["ran"] == 1  # the two faults fired BEFORE the op ran
+
+
+# ---------------------------------------------------------------------------
+# chaos spec parsing + determinism
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec():
+    evs = chaos_lib.parse_spec("sigterm@step=7, nan_grad@step=5,truncate_ckpt")
+    assert [(e.name, e.key, e.value) for e in evs] == [
+        ("sigterm", "step", 7), ("nan_grad", "step", 5),
+        ("truncate_ckpt", "save", 1)]
+    for junk in ("frobnicate@step=1", "sigterm", "sigterm@save=1",
+                 "sigterm@step=x", ",", ""):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_spec(junk)
+
+
+def test_chaos_nan_grad_poisons_floats_only_and_logs(tmp_path, monkeypatch):
+    monkeypatch.setattr(chaos_lib.ChaosEngine, "STALL_S", 0.01)
+    spec, seed = "nan_grad@step=2,loader_stall@batch=4", 3
+
+    def drive(log_dir):
+        eng = chaos_lib.ChaosEngine(spec, seed=seed, log_dir=str(log_dir))
+        eng.steps_per_epoch = SPE
+        for g in range(6):
+            batch = {"image": np.ones((2, 4), np.float32),
+                     "label": np.arange(2, dtype=np.int32)}
+            out = eng.batch_hook(g // SPE, g % SPE, batch)
+            if g == 2:
+                assert np.isnan(out["image"]).all()
+                assert (out["label"] == batch["label"]).all()  # ints intact
+                assert not np.isnan(batch["image"]).any()  # input not mutated
+            else:
+                assert out is batch
+        return (log_dir / chaos_lib.CHAOS_LOG).read_text()
+
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    log1, log2 = drive(d1), drive(d2)
+    assert log1 == log2  # same spec + seed -> byte-identical injection log
+    rows = [json.loads(line) for line in log1.splitlines()]
+    assert {r["event"] for r in rows} == {"nan_grad", "loader_stall"}
+    assert all(r["seed"] == seed for r in rows)
+
+
+def test_chaos_events_fire_once():
+    eng = chaos_lib.ChaosEngine("nan_grad@step=1", seed=0)
+    eng.steps_per_epoch = SPE
+    batch = {"x": np.ones(3, np.float32)}
+    assert np.isnan(eng.batch_hook(0, 1, batch)["x"]).all()
+    assert eng.batch_hook(0, 1, batch) is batch  # resumed run: no re-trip
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: CRC fallback, manifest tolerance, wait() re-raise
+# ---------------------------------------------------------------------------
+
+
+def _state(mesh, seed=0):
+    bundle = registry.create_model("resnet_micro", num_classes=10,
+                                   image_size=32, dtype=jnp.float32,
+                                   param_dtype=jnp.float32)
+    tx, _ = optim.build_optimizer(Config(), steps_per_epoch=10)
+    rules = sharding_lib.strategy_rules("dp", bundle.rules)
+    return train_loop.create_train_state(bundle.module, tx,
+                                         bundle.input_template, mesh, rules,
+                                         seed=seed)
+
+
+def _two_saves(tmp_path, devices):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+    s1, s2 = _state(mesh, seed=1), _state(mesh, seed=2)
+    ck.save(s1, 1, extra={"tag": 1}, block=True)
+    ck.save(s2, 2, extra={"tag": 2}, block=True)
+    return ck, mesh, s1
+
+
+def _first_array_file(tmp_path, step):
+    arrays = os.path.join(str(tmp_path), f"step_{step:08d}", "arrays")
+    return os.path.join(arrays, sorted(os.listdir(arrays))[0])
+
+
+def test_crc_bitflip_falls_back_to_previous_step(tmp_path, devices):
+    ck, mesh, s1 = _two_saves(tmp_path, devices)
+    target = _first_array_file(tmp_path, 2)
+    with open(target, "r+b") as fh:
+        fh.seek(-1, os.SEEK_END)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_END)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+    restored, extra = ck.restore(_state(mesh, seed=9))
+    assert ck.last_restored_step == 1 and extra == {"tag": 1}
+    for x, y in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(s1.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(ckpt_lib.CheckpointCorruptError, match="CRC mismatch"):
+        ck.restore(_state(mesh, seed=9), step=2)
+
+
+def test_truncated_file_falls_back(tmp_path, devices):
+    ck, mesh, _ = _two_saves(tmp_path, devices)
+    target = _first_array_file(tmp_path, 2)
+    with open(target, "r+b") as fh:
+        fh.truncate(max(os.path.getsize(target) // 2, 1))
+    ck.restore(_state(mesh, seed=9))
+    assert ck.last_restored_step == 1
+
+
+def test_missing_manifest_falls_back(tmp_path, devices):
+    ck, mesh, _ = _two_saves(tmp_path, devices)
+    os.remove(os.path.join(str(tmp_path), "step_00000002",
+                           ckpt_lib.MANIFEST_FILE))
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 1
+    ck.restore(_state(mesh, seed=9))
+    assert ck.last_restored_step == 1
+
+
+def test_garbage_manifest_falls_back(tmp_path, devices):
+    ck, mesh, _ = _two_saves(tmp_path, devices)
+    with open(os.path.join(str(tmp_path), "step_00000002",
+                           ckpt_lib.MANIFEST_FILE), "w") as fh:
+        fh.write("{not json")
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 1
+    ck.restore(_state(mesh, seed=9))
+    assert ck.last_restored_step == 1
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path, devices):
+    ck, mesh, _ = _two_saves(tmp_path, devices)
+    for step in (1, 2):
+        target = _first_array_file(tmp_path, step)
+        with open(target, "r+b") as fh:
+            fh.truncate(1)
+    with pytest.raises(ckpt_lib.CheckpointCorruptError,
+                       match="every committed checkpoint"):
+        ck.restore(_state(mesh, seed=9))
+
+
+def test_quarantine_hides_step_from_discovery(tmp_path, devices):
+    ck, _, _ = _two_saves(tmp_path, devices)
+    ck.quarantine(2)
+    assert ckpt_lib.all_checkpoints(str(tmp_path)) == [1]
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 1
+    assert os.path.isdir(os.path.join(str(tmp_path),
+                                      "step_00000002.poisoned"))
+
+
+def test_wait_reraises_background_write_failure(tmp_path, devices):
+    mesh = mesh_lib.build_mesh({"data": 8})
+    ck = ckpt_lib.Checkpointer(str(tmp_path))
+
+    def always_fail(what):
+        if what == "ckpt_write":
+            raise OSError("injected: disk on fire")
+
+    resilience.set_fault_hook(always_fail)
+    try:
+        ck.save(_state(mesh), 1, block=False)
+        with pytest.raises(ckpt_lib.CheckpointWriteError,
+                           match="disk on fire"):
+            ck.wait()
+    finally:
+        resilience.set_fault_hook(None)
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) is None
+    # The error is cleared once raised; the next save succeeds cleanly.
+    ck.save(_state(mesh), 2, block=False)
+    ck.wait()
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == 2
+
+
+# ---------------------------------------------------------------------------
+# watchdog fixes (satellite): polling block_until_ready, no late fires
+# ---------------------------------------------------------------------------
+
+
+def test_block_until_ready_timeout_no_thread_leak(devices):
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+    before = threading.active_count()
+    with pytest.raises(TimeoutError, match="not ready after"):
+        watchdog.block_until_ready_with_timeout(
+            {"a": NeverReady()}, timeout_s=0.05, poll_s=0.005)
+    assert threading.active_count() == before  # old impl leaked one/call
+    # Ready trees (device arrays AND plain host leaves) pass through.
+    watchdog.block_until_ready_with_timeout(
+        {"x": jnp.ones(3), "y": np.ones(3), "z": 1.0}, timeout_s=5.0)
+
+
+def test_watchdog_stop_joins_thread():
+    w = watchdog.Watchdog(timeout_s=0.02, fatal=False).start()
+    time.sleep(0.05)
+    w.stop()
+    assert not w._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# launch.py supervisor (no jax in the child: pure restart-policy logic)
+# ---------------------------------------------------------------------------
+
+
+def _write_preempt_script(tmp_path):
+    script = tmp_path / "fake_job.py"
+    script.write_text(
+        "import os, sys\n"
+        "marker = sys.argv[1]\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(75)\n"
+        "open(marker + '.resumed', 'w').write(' '.join(sys.argv[2:]))\n"
+        "sys.exit(0)\n")
+    return script
+
+
+def test_supervisor_restarts_on_preempt_with_resume(tmp_path):
+    script = _write_preempt_script(tmp_path)
+    marker = tmp_path / "preempted"
+    res = subprocess.run(
+        [sys.executable, "launch.py", "--nprocs", "1",
+         "--restart-policy", "on-preempt", "--max-restarts", "2",
+         "--restart-backoff", "0.05", "--log-dir", str(tmp_path), "--",
+         str(script), str(marker)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    assert "restart 1/2" in res.stderr, res.stderr
+    assert (tmp_path / "preempted.resumed").read_text() == "--resume auto"
+
+
+def test_supervisor_never_policy_propagates_exit(tmp_path):
+    script = _write_preempt_script(tmp_path)
+    res = subprocess.run(
+        [sys.executable, "launch.py", "--nprocs", "1",
+         "--log-dir", str(tmp_path), "--",
+         str(script), str(tmp_path / "m")],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert res.returncode == resilience.PREEMPTED_EXIT_CODE
+    assert not (tmp_path / "m.resumed").exists()
+
+
+def test_supervisor_budget_exhausted_returns_last_code(tmp_path):
+    script = tmp_path / "always75.py"
+    script.write_text("import sys; sys.exit(75)\n")
+    res = subprocess.run(
+        [sys.executable, "launch.py", "--nprocs", "1",
+         "--restart-policy", "on-preempt", "--max-restarts", "1",
+         "--restart-backoff", "0.05", "--log-dir", str(tmp_path), "--",
+         str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert res.returncode == resilience.PREEMPTED_EXIT_CODE
+    assert "restart budget exhausted" in res.stderr, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# end-to-end CLI drills (subprocess: real main.py, chaos injected)
+# ---------------------------------------------------------------------------
+
+
+def _train_cmd(ckdir, extra=()):
+    return [sys.executable, "main.py", "--platform", "cpu",
+            "--fake-devices", "2", "--config", "resnet18_cifar10",
+            "--model", "resnet_micro", "--epochs", "1",
+            "--steps-per-epoch", str(SPE), "--batch-size", "16",
+            "--workers", "0", "--log-every", "1",
+            "--checkpoint-dir", str(ckdir), *extra]
+
+
+def _run(cmd, idx_log=None, timeout=420):
+    env = dict(os.environ)
+    if idx_log is not None:
+        env[INDEX_LOG_ENV] = str(idx_log)
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _consumed(path):
+    # First-yield wins and only in-epoch batches count: prefetch lookahead
+    # legitimately overfetches past a kill point, and a resumed run re-logs
+    # the batch it restarts on.
+    out = {}
+    for line in open(path):
+        r = json.loads(line)
+        key = (r["epoch"], r["batch"])
+        if r["batch"] < SPE and key not in out:
+            out[key] = r["indices"]
+    return out
+
+
+def _committed_steps(ckdir):
+    return [d for d in sorted(os.listdir(ckdir)) if d.startswith("step_")
+            and os.path.exists(os.path.join(ckdir, d, ckpt_lib.COMMIT_FILE))]
+
+
+def test_cli_sigterm_takes_emergency_checkpoint(tmp_path):
+    ckdir = tmp_path / "ck"
+    res = _run(_train_cmd(ckdir, ["--chaos", "sigterm@step=3"]))
+    assert res.returncode == resilience.PREEMPTED_EXIT_CODE, (
+        res.returncode, res.stdout[-2000:], res.stderr[-2000:])
+    assert "emergency checkpoint committed" in res.stderr + res.stdout
+    assert _committed_steps(ckdir), "no committed emergency checkpoint"
+    rows = [json.loads(line) for line in
+            open(ckdir / chaos_lib.CHAOS_LOG)]
+    assert len(rows) == 1 and rows[0]["event"] == "sigterm", rows
+    assert rows[0]["step"] == 3, rows
+
+
+def test_cli_nan_grad_rollback_is_sample_exact(tmp_path):
+    flags = ["--telemetry", "--health-every", "1",
+             "--checkpoint-every-steps", "2"]
+    ref = _run(_train_cmd(tmp_path / "ck_ref", flags),
+               idx_log=tmp_path / "ref_idx")
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+
+    res = _run(_train_cmd(
+        tmp_path / "ck", [*flags, "--anomaly-action", "rollback",
+                          "--chaos", "nan_grad@step=3"]),
+        idx_log=tmp_path / "idx")
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    err = res.stderr + res.stdout
+    assert "anomaly rollback" in err, err[-3000:]
+    assert _consumed(tmp_path / "idx") == _consumed(tmp_path / "ref_idx"), (
+        "rollback run consumed a different sample stream")
+
+
+def test_cli_ckpt_io_error_retries_then_commits(tmp_path):
+    ckdir = tmp_path / "ck"
+    res = _run(_train_cmd(ckdir, ["--checkpoint-every-steps", "2",
+                                  "--chaos", "ckpt_io_error@save=1"]))
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    err = res.stderr + res.stdout
+    assert "retriable io [ckpt_write] failed" in err, err[-3000:]
+    assert _committed_steps(ckdir), "injected io errors lost the checkpoint"

@@ -68,6 +68,11 @@ def test_block_with_timeout_passes_and_raises():
     wd.block_until_ready_with_timeout(x, timeout_s=30)
 
     class Never:
+        # The hung-dispatch contract is polled via is_ready() (r9: the old
+        # helper-thread-in-block_until_ready version leaked the thread).
+        def is_ready(self):
+            return False
+
         def block_until_ready(self):
             time.sleep(60)
 
