@@ -51,6 +51,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                moe_capacity_factor: float = 1.25,
                moe_top_k: int = 2, moe_dispatch_impl: str = "gather",
                moe_combine_dtype: str = "fp32",
+               moe_router_dtype: str = "fp32",
+               moe_router_impl: str = "reference",
                remat_policy: str = "nothing", telemetry: bool = False):
     """Build (mesh, state, step_fn, device batch, bundle) exactly as the
     benchmark measures them — shared by bench() and benchmarks/profile_step.py
@@ -79,6 +81,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                                    moe_top_k=moe_top_k,
                                    moe_dispatch_impl=moe_dispatch_impl,
                                    moe_combine_dtype=moe_combine_dtype,
+                                   moe_router_dtype=moe_router_dtype,
+                                   moe_router_impl=moe_router_impl,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -104,6 +108,7 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           remat: bool = False, devices=None, attn_impl: str = "auto",
           moe_capacity_factor: float = 1.25, moe_top_k: int = 2,
           moe_dispatch_impl: str = "gather", moe_combine_dtype: str = "fp32",
+          moe_router_dtype: str = "fp32", moe_router_impl: str = "reference",
           remat_policy: str = "nothing", telemetry: bool = False):
     import jax
     import numpy as np
@@ -116,6 +121,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                     moe_capacity_factor=moe_capacity_factor,
                     moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
                     moe_combine_dtype=moe_combine_dtype,
+                    moe_router_dtype=moe_router_dtype,
+                    moe_router_impl=moe_router_impl,
                     remat_policy=remat_policy, telemetry=telemetry)
     mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
                                         su["batch"], su["bundle"])
@@ -224,6 +231,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             **({"moe_dispatch_impl": moe_dispatch_impl,
                 "moe_top_k": moe_top_k,
                 "moe_combine_dtype": moe_combine_dtype,
+                "moe_router_dtype": moe_router_dtype,
+                "moe_router_impl": moe_router_impl,
                 "moe_capacity_factor": moe_capacity_factor}
                if "moe" in model_name else {}),
             **({"remat_policy": remat_policy}
@@ -421,6 +430,16 @@ def main(argv=None):
     p.add_argument("--moe-dispatch", default="gather",
                    choices=["sort", "gather", "einsum"], dest="moe_dispatch",
                    help="MoE dispatch formulation (parallel/moe.py)")
+    p.add_argument("--moe-router-dtype", default="fp32",
+                   choices=["fp32", "bf16"], dest="moe_router_dtype",
+                   help="router logits-matmul precision (fp32 = ST-MoE "
+                        "exact default; bf16 keeps fp32 accumulation and "
+                        "softmax/top-k)")
+    p.add_argument("--moe-router-impl", default="reference",
+                   choices=["reference", "fused"], dest="moe_router_impl",
+                   help="router softmax/top-k/gates: reference XLA chain or "
+                        "the fused single-pass Pallas kernel "
+                        "(ops/fused_router.py)")
     p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"],
                    help="combine-einsum precision (router stays fp32)")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
@@ -453,6 +472,8 @@ def main(argv=None):
                    moe_top_k=args.moe_top_k,
                    moe_dispatch_impl=args.moe_dispatch,
                    moe_combine_dtype=args.moe_combine,
+                   moe_router_dtype=args.moe_router_dtype,
+                   moe_router_impl=args.moe_router_impl,
                    remat_policy=args.remat_policy, telemetry=args.telemetry)
     if (args.model == "resnet50" and not args.no_measured_roofline):
         # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer

@@ -6,9 +6,17 @@ for the device it ran on; exit 1 if any matched metric regressed more than
 ``--tolerance`` (default 10%). Metrics or devices without a golden entry are
 reported but never fail — new hardware/new benchmarks need a first recording.
 
+``--aot-bytes`` gates a ``profile_step.py --aot`` report instead: per-region
+modeled HBM bytes versus the ``aot_regions`` section of golden.json. Bytes
+regress UPWARD (more traffic = worse), it needs no chip (the numbers are
+facts of the lowered program), and ``--record`` writes the first golden.
+
 Usage:
     python bench.py | python benchmarks/check_regression.py
     python benchmarks/check_regression.py BENCH_r02.json
+    python benchmarks/profile_step.py --model llama_moe --aot \
+        --moe-dispatch gather | python benchmarks/check_regression.py \
+        --aot-bytes
 """
 
 from __future__ import annotations
@@ -90,6 +98,71 @@ def check(result: dict, golden: dict, tolerance: float = 0.10):
     return failures, report
 
 
+def aot_key(result: dict) -> str:
+    """Golden key for an aot_report: model + shape + dispatch formulation."""
+    return (f"{result['model']} b{result['per_chip_batch']} "
+            f"s{result['seq_len']} {result.get('moe_dispatch_impl', '-')}")
+
+
+def check_aot_bytes(result: dict, golden: dict, tolerance: float = 0.10):
+    """Gate per-region AOT modeled bytes against golden.json ``aot_regions``.
+
+    Unlike throughput (lower = regression), modeled bytes regress UPWARD:
+    a region fails when its gbytes_modeled exceeds the golden by more than
+    ``tolerance``. Shrinking is always fine — improvements re-record.
+    Goldens are specific to the lowering backend (XLA:CPU fuses differently
+    from TPU) and to the fusion-attribution model, so a mismatch on either
+    field skips the comparison rather than failing on incomparable numbers.
+    """
+    failures, report = [], []
+    key = aot_key(result)
+    entry = golden.get("aot_regions", {}).get(key)
+    if not entry:
+        report.append(f"NO-GOLDEN aot_regions[{key}]: record with --record")
+        return failures, report
+    for field in ("backend_lowering", "attribution"):
+        if entry.get(field) != result.get(field):
+            report.append(
+                f"SKIP aot_regions[{key}]: {field} mismatch "
+                f"(golden {entry.get(field)!r}, result {result.get(field)!r})")
+            return failures, report
+    for region, ref in sorted(entry["regions"].items()):
+        row = result.get("regions", {}).get(region)
+        if row is None:
+            report.append(f"NO-REGION {region} ({key}): absent from result")
+            continue
+        val = float(row["gbytes_modeled"])
+        ratio = val / ref if ref else (float("inf") if val else 1.0)
+        line = (f"aot_bytes {region} ({key}): {val:.3f} GB vs golden "
+                f"{ref:.3f} GB ({ratio:.2%})")
+        if ratio > 1.0 + tolerance:
+            failures.append(line)
+            report.append("REGRESSION " + line)
+        else:
+            report.append("OK " + line)
+    return failures, report
+
+
+def record_aot_golden(result: dict, path: str = GOLDEN_PATH) -> str:
+    """Write a report's per-region bytes as the golden entry (full-file
+    rewrite: golden.json is small and hand-tended)."""
+    with open(path) as fh:
+        golden = json.load(fh)  # keep "_"-prefixed comment keys
+    entry = {
+        "backend_lowering": result.get("backend_lowering"),
+        "attribution": result.get("attribution"),
+        "regions": {tag: row["gbytes_modeled"]
+                    for tag, row in result.get("regions", {}).items()},
+    }
+    if result.get("xla_flops_per_step") is not None:
+        entry["xla_flops_per_step"] = result["xla_flops_per_step"]
+    golden.setdefault("aot_regions", {})[aot_key(result)] = entry
+    with open(path, "w") as fh:
+        json.dump(golden, fh, indent=2)
+        fh.write("\n")
+    return aot_key(result)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("result", nargs="?", help="bench JSON file (default: stdin)")
@@ -98,8 +171,32 @@ def main(argv=None):
                    help="also scan this run's metrics.jsonl for non-finite "
                         "training-health scalars (telemetry rows); any hit "
                         "fails the gate")
+    p.add_argument("--aot-bytes", action="store_true",
+                   help="input is a profile_step.py --aot report: gate "
+                        "per-region modeled bytes (UP is the regression "
+                        "direction) against golden.json aot_regions; runs "
+                        "without a chip")
+    p.add_argument("--record", action="store_true",
+                   help="with --aot-bytes: write the report's regions as "
+                        "the golden entry instead of comparing")
     args = p.parse_args(argv)
     failures, report = [], []
+    if args.aot_bytes:
+        raw = open(args.result).read() if args.result else sys.stdin.read()
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError:
+            data = json.loads(raw.strip().splitlines()[-1])
+        result = data.get("parsed", data)
+        if args.record:
+            key = record_aot_golden(result)
+            print(f"RECORDED aot_regions[{key}]")
+            return 0
+        failures, report = check_aot_bytes(result, load_golden(),
+                                           args.tolerance)
+        for line in report:
+            print(line)
+        return 1 if failures else 0
     # --metrics-jsonl alone is a health-only scan (no bench row expected on
     # stdin); a positional result file, or plain piped usage, still runs the
     # golden comparison.

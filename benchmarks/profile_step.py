@@ -167,6 +167,73 @@ def build_op_moe_tags(hlo_text: str):
     return op_moe
 
 
+def build_op_moe_weights(hlo_text: str):
+    """Map instruction name -> {region: fraction} for PROPORTIONAL byte
+    attribution of mixed fusions.
+
+    ``build_op_moe_tags`` is winner-take-all: a fusion goes to whichever
+    region tags the most interior lines. That is right for the trace-timing
+    path (a timed event is indivisible) but wrong for byte accounting on
+    XLA:CPU, which builds whole-block backward mega-fusions (~900
+    instructions) where a handful of tagged lines — e.g. 24 moe_router
+    [T,d] cotangent converts vs 12 moe_dispatch lines, 96% untagged —
+    decided the winner and charged the entire fusion's boundary traffic to
+    one region (r7 recorded 125 GB of "router" bytes this way; the genuine
+    router share is ~2.3x smaller).
+
+    Here each fusion's bytes are split by the RESULT bytes of its tagged
+    interior lines over all non-view interior result bytes; the untagged
+    remainder stays unattributed (the caller charges it to non_moe).
+    Fusions whose interior carries tags but zero bytes (scalar reducers)
+    fall back to line majority. Non-fusion tagged instructions keep their
+    own tag at weight 1.0. Fractions for an op sum to <= 1."""
+    comp_bodies = {}
+    for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?:\.clone)? \([^)]*\) -> .*? \{\n(.*?)^\}",
+                         hlo_text, re.M | re.S):
+        comp_bodies[m.group(1)] = m.group(2)
+    line_re = re.compile(
+        r"\s+(?:ROOT )?%?([\w.\-]+) = (.*?)([a-z][a-z0-9\-]*)\(")
+
+    comp_frac: dict[str, dict[str, float]] = {}
+    for name, body in comp_bodies.items():
+        tag_bytes: collections.Counter = collections.Counter()
+        tag_lines: collections.Counter = collections.Counter()
+        total = 0
+        for line in body.splitlines():
+            im = line_re.match(line)
+            if not im or im.group(3) in _VIEW_OPS:
+                continue
+            b = sum(_shape_bytes(dt, dims)
+                    for dt, dims, _ in _SHAPE_LAYOUT_RE.findall(im.group(2)))
+            total += b
+            t = _moe_tag(line)
+            if t:
+                tag_bytes[t] += b
+                tag_lines[t] += 1
+        if total:
+            comp_frac[name] = {t: b / total for t, b in tag_bytes.items()}
+        elif tag_lines:
+            comp_frac[name] = {tag_lines.most_common(1)[0][0]: 1.0}
+
+    op_w: dict[str, dict[str, float]] = {}
+    for name, body in comp_bodies.items():
+        for line in body.splitlines():
+            im = line_re.match(line)
+            if not im:
+                continue
+            op, opcode = im.group(1), im.group(3)
+            if opcode == "fusion":
+                cm = re.search(r"calls=%?([\w.\-]+)", line)
+                w = comp_frac.get(cm.group(1)) if cm else None
+                if w:
+                    op_w[op] = w
+                    continue
+            t = _moe_tag(line)
+            if t:
+                op_w[op] = {t: 1.0}
+    return op_w
+
+
 _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
                 "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
                 "f64": 8, "f8e4m3fn": 1, "f8e5m2": 1}
@@ -315,6 +382,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             remat_policy="nothing",
             attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
             moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+            moe_router_dtype="fp32", moe_router_impl="reference",
             steps=3, trace_dir=None, top=25, telemetry=False):
     import jax
 
@@ -331,6 +399,8 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
                     moe_top_k=moe_top_k,
                     moe_dispatch_impl=moe_dispatch_impl,
                     moe_combine_dtype=moe_combine_dtype,
+                    moe_router_dtype=moe_router_dtype,
+                    moe_router_impl=moe_router_impl,
                     telemetry=telemetry)
     mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
     bundle = su["bundle"]
@@ -456,7 +526,8 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
                seq_len=2048, strategy=None, remat=False,
                remat_policy="nothing", attn_impl="auto",
                moe_capacity_factor=1.0, moe_top_k=2,
-               moe_dispatch_impl="gather", moe_combine_dtype="fp32"):
+               moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+               moe_router_dtype="fp32", moe_router_impl="reference"):
     """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
 
     AOT-lowers the SAME train step bench.py times — same registry model,
@@ -468,7 +539,17 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     mix. No timing. The fusion/schedule is THIS process' XLA backend (on a
     CPU host: XLA:CPU) — op counts and logical bytes are facts of the
     lowered program, but TPU fusion differs, so downstream consumers must
-    label these numbers derived, not measured."""
+    label these numbers derived, not measured.
+
+    Region BYTES use proportional attribution (``build_op_moe_weights``):
+    a mixed fusion's traffic is split across regions by interior-line
+    result bytes instead of winner-take-all line majority, which on
+    XLA:CPU charged whole-block backward mega-fusions to whichever MoE
+    region tagged a few cotangent lines (see the r8 PROFILE_MOE.md
+    addendum). Integer op counts and the category mix still use the
+    majority map — an instruction is one op in one region. The output
+    carries ``"attribution": "proportional_bytes"`` so byte goldens
+    recorded under one model never compare against the other."""
     import jax
     import jax.numpy as jnp
 
@@ -498,6 +579,8 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
                                    moe_top_k=moe_top_k,
                                    moe_dispatch_impl=moe_dispatch_impl,
                                    moe_combine_dtype=moe_combine_dtype,
+                                   moe_router_dtype=moe_router_dtype,
+                                   moe_router_impl=moe_router_impl,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -530,16 +613,25 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     op_cat, _ = build_op_categories(hlo_text)
     op_bytes = build_op_bytes(hlo_text)
     op_moe = build_op_moe_tags(hlo_text)
+    op_w = build_op_moe_weights(hlo_text)
 
     regions: dict[str, dict] = {}
+
+    def row(tag):
+        return regions.setdefault(tag, {"ops": 0, "gbytes_modeled": 0.0,
+                                        "by_category": collections.Counter()})
+
     for op, b in op_bytes.items():
-        tag = op_moe.get(op, "non_moe")
-        row = regions.setdefault(tag, {"ops": 0, "gbytes_modeled": 0.0,
-                                       "by_category": collections.Counter()})
-        row["ops"] += 1
-        row["gbytes_modeled"] += b / 1e9
+        assigned = 0.0
+        for tag, frac in op_w.get(op, {}).items():
+            row(tag)["gbytes_modeled"] += b * frac / 1e9
+            assigned += frac
+        if assigned < 1.0:
+            row("non_moe")["gbytes_modeled"] += b * (1.0 - assigned) / 1e9
+        r = row(op_moe.get(op, "non_moe"))
+        r["ops"] += 1
         if b or op_cat.get(op) not in (None, "copy_layout"):
-            row["by_category"][op_cat.get(op, "?")] += 1
+            r["by_category"][op_cat.get(op, "?")] += 1
     for row in regions.values():
         row["gbytes_modeled"] = round(row["gbytes_modeled"], 3)
         row["by_category"] = dict(row["by_category"].most_common(6))
@@ -551,6 +643,7 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         ca = ca[0] if ca else {}
     return {
         "mode": "aot_hlo_model",
+        "attribution": "proportional_bytes",
         "backend_lowering": jax.default_backend(),
         "model": model_name,
         "per_chip_batch": per_chip_batch,
@@ -560,6 +653,8 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         "moe_dispatch_impl": moe_dispatch_impl,
         "moe_top_k": moe_top_k,
         "moe_combine_dtype": moe_combine_dtype,
+        "moe_router_dtype": moe_router_dtype,
+        "moe_router_impl": moe_router_impl,
         "moe_capacity_factor": moe_capacity_factor,
         "xla_flops_per_step": ca.get("flops"),
         "xla_bytes_accessed": ca.get("bytes accessed"),
@@ -584,6 +679,10 @@ def main(argv=None):
     p.add_argument("--moe-dispatch", default="gather",
                    choices=["sort", "gather", "einsum"])
     p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"])
+    p.add_argument("--moe-router-dtype", default="fp32",
+                   choices=["fp32", "bf16"])
+    p.add_argument("--moe-router-impl", default="reference",
+                   choices=["reference", "fused"])
     p.add_argument("--moe-capacity-factor", type=float, default=1.25)
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
@@ -606,7 +705,9 @@ def main(argv=None):
                          moe_capacity_factor=args.moe_capacity_factor,
                          moe_top_k=args.moe_top_k,
                          moe_dispatch_impl=args.moe_dispatch,
-                         moe_combine_dtype=args.moe_combine)
+                         moe_combine_dtype=args.moe_combine,
+                         moe_router_dtype=args.moe_router_dtype,
+                         moe_router_impl=args.moe_router_impl)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(res, f, indent=1)
@@ -621,6 +722,8 @@ def main(argv=None):
                   moe_top_k=args.moe_top_k,
                   moe_dispatch_impl=args.moe_dispatch,
                   moe_combine_dtype=args.moe_combine,
+                  moe_router_dtype=args.moe_router_dtype,
+                  moe_router_impl=args.moe_router_impl,
                   steps=args.steps, top=args.top, telemetry=args.telemetry)
     if args.out:
         with open(args.out, "w") as f:

@@ -77,7 +77,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-combine", default=None, dest="moe_combine_dtype",
                    choices=["fp32", "bf16"],
                    help="combine-einsum precision (bf16 halves combine "
-                        "bandwidth; router always fp32)")
+                        "bandwidth; router softmax/top-k always fp32)")
+    p.add_argument("--moe-router-dtype", default=None, dest="moe_router_dtype",
+                   choices=["fp32", "bf16"],
+                   help="router logits-matmul precision (fp32 = ST-MoE "
+                        "exact default; bf16 keeps fp32 accumulation and "
+                        "fp32 softmax/top-k)")
+    p.add_argument("--moe-router-impl", default=None, dest="moe_router_impl",
+                   choices=["reference", "fused"],
+                   help="router softmax/top-k/gates: reference XLA chain "
+                        "(default) or the fused single-pass Pallas kernel "
+                        "(ops/fused_router.py)")
     p.add_argument("--dropout", type=float, default=None,
                    help="model dropout rate (families that support it)")
     p.add_argument("--tensorboard-dir", type=str, default=None,

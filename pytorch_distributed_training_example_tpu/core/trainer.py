@@ -111,6 +111,8 @@ class Trainer:
             moe_top_k=cfg.moe_top_k,
             moe_dispatch_impl=cfg.moe_dispatch_impl,
             moe_combine_dtype=cfg.moe_combine_dtype,
+            moe_router_dtype=cfg.moe_router_dtype,
+            moe_router_impl=cfg.moe_router_impl,
             logits_dtype=self.policy.logits_dtype)
 
         # data ------------------------------------------------------------

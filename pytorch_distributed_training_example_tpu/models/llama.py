@@ -110,6 +110,8 @@ class LlamaBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_dispatch_impl: str = "gather"  # sort | gather | einsum (parallel/moe.py)
     moe_combine_dtype: Any = None      # None -> fp32 combine (exact)
+    moe_router_dtype: Any = None       # None -> fp32 logits matmul (exact)
+    moe_router_impl: str = "reference"  # reference | fused (ops/fused_router)
     sp: bool = False
 
     @nn.compact
@@ -130,6 +132,8 @@ class LlamaBlock(nn.Module):
                          capacity_factor=self.moe_capacity_factor,
                          dispatch_impl=self.moe_dispatch_impl,
                          combine_dtype=self.moe_combine_dtype,
+                         router_dtype=self.moe_router_dtype,
+                         router_impl=self.moe_router_impl,
                          dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
         else:
@@ -186,6 +190,8 @@ class Llama(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_dispatch_impl: str = "gather"
     moe_combine_dtype: Any = None
+    moe_router_dtype: Any = None
+    moe_router_impl: str = "reference"
     sp: bool = False
     logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
@@ -216,7 +222,9 @@ class Llama(nn.Module):
             num_experts=self.num_experts, moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_dispatch_impl=self.moe_dispatch_impl,
-            moe_combine_dtype=self.moe_combine_dtype, sp=self.sp)
+            moe_combine_dtype=self.moe_combine_dtype,
+            moe_router_dtype=self.moe_router_dtype,
+            moe_router_impl=self.moe_router_impl, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts

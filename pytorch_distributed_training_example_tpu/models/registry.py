@@ -48,6 +48,8 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  moe_top_k: int = 2,
                  moe_dispatch_impl: str = "gather",
                  moe_combine_dtype: str = "fp32",
+                 moe_router_dtype: str = "fp32",
+                 moe_router_impl: str = "reference",
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
@@ -76,24 +78,39 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
         attn_impl=attn_impl, dropout=dropout,
         moe_capacity_factor=moe_capacity_factor,
         moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
-        moe_combine_dtype=moe_combine_dtype, logits_dtype=logits_dtype,
+        moe_combine_dtype=moe_combine_dtype,
+        moe_router_dtype=moe_router_dtype, moe_router_impl=moe_router_impl,
+        logits_dtype=logits_dtype,
     )
 
 
-# --moe-combine flag values -> MoEBlock.combine_dtype (None = fp32, exact)
+# --moe-combine flag values -> MoEBlock.combine_dtype (None = fp32, exact);
+# --moe-router-dtype uses the same spelling for MoEBlock.router_dtype.
 _MOE_COMBINE_DTYPES = {"fp32": None, "bf16": jnp.bfloat16}
+_MOE_ROUTER_IMPLS = ("reference", "fused")
 
 
 def _moe_kwargs(moe_capacity_factor, moe_top_k, moe_dispatch_impl,
-                moe_combine_dtype):
+                moe_combine_dtype, moe_router_dtype="fp32",
+                moe_router_impl="reference"):
     if moe_combine_dtype not in _MOE_COMBINE_DTYPES:
         raise ValueError(
             f"unknown moe_combine_dtype {moe_combine_dtype!r}; "
             f"have {sorted(_MOE_COMBINE_DTYPES)}")
+    if moe_router_dtype not in _MOE_COMBINE_DTYPES:
+        raise ValueError(
+            f"unknown moe_router_dtype {moe_router_dtype!r}; "
+            f"have {sorted(_MOE_COMBINE_DTYPES)}")
+    if moe_router_impl not in _MOE_ROUTER_IMPLS:
+        raise ValueError(
+            f"unknown moe_router_impl {moe_router_impl!r}; "
+            f"have {list(_MOE_ROUTER_IMPLS)}")
     return dict(moe_capacity_factor=moe_capacity_factor,
                 moe_top_k=moe_top_k,
                 moe_dispatch_impl=moe_dispatch_impl,
-                moe_combine_dtype=_MOE_COMBINE_DTYPES[moe_combine_dtype])
+                moe_combine_dtype=_MOE_COMBINE_DTYPES[moe_combine_dtype],
+                moe_router_dtype=_MOE_COMBINE_DTYPES[moe_router_dtype],
+                moe_router_impl=moe_router_impl)
 
 
 @register("vit_b16")
@@ -215,6 +232,7 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
                     remat_policy="nothing", sp=False,
                     attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
                     moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+                    moe_router_dtype="fp32", moe_router_impl="reference",
                     logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
@@ -225,7 +243,9 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
                                   logits_dtype=logits_dtype,
                                   **_moe_kwargs(moe_capacity_factor, moe_top_k,
                                                 moe_dispatch_impl,
-                                                moe_combine_dtype))
+                                                moe_combine_dtype,
+                                                moe_router_dtype,
+                                                moe_router_impl))
     # MFU basis = ACTIVE params (top-2 experts), not the full expert stack
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
@@ -236,6 +256,7 @@ def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                sp=False,
                attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
                moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+               moe_router_dtype="fp32", moe_router_impl="reference",
                logits_dtype, **_):
     """Bench-scale MoE (llama trunk, 8 experts top-2, ~520M total): the
     e2e EP perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
@@ -248,7 +269,9 @@ def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                                   logits_dtype=logits_dtype,
                                   **_moe_kwargs(moe_capacity_factor, moe_top_k,
                                                 moe_dispatch_impl,
-                                                moe_combine_dtype))
+                                                moe_combine_dtype,
+                                                moe_router_dtype,
+                                                moe_router_impl))
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
 

@@ -10,6 +10,16 @@ dropping, and the standard load-balancing auxiliary loss (mean(gates)*
 fraction-routed per expert, scaled by E), surfaced via the flax ``sow``
 mechanism under the ``"losses"`` collection as ``moe_aux_loss``.
 
+Routing bookkeeping is compact-index (MegaBlocks' lesson, Gale et al. 2023):
+one stable argsort + bincount over ``expert_idx`` (``routing_stats``) yields
+the per-expert counts, segment starts, and within-queue positions that the
+dispatch, the Switch aux loss, the z-loss, and the telemetry sows all share.
+No fp32 ``[T, E]``/``[T, k, E]`` one-hot is materialized outside the einsum
+dispatch impl (whose explicit masks are its definition); the shared stats
+are ``[E]``/``[k·T]``-shaped int32. The routing *decision* (fp32 softmax +
+``lax.top_k``) is unchanged — the compact path is equivalence-tested
+against the one-hot reference in tests/test_moe_router.py.
+
 Three dispatch implementations share identical routing/drop semantics (the
 priority order is: earlier tokens first, k=0 choices before k=1) and are
 equivalence-tested against each other — see ``dispatch_impl`` on
@@ -21,7 +31,7 @@ per region from an xplane trace (PROFILE_MOE.md).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import flax.linen as nn
 import jax
@@ -56,27 +66,122 @@ class ExpertFFN(nn.Module):
         return out
 
 
+class RouterDense(nn.Module):
+    """Router logits in fp32 WITHOUT an fp32 copy of the [T, d] token block.
+
+    ``nn.Dense(dtype=f32)`` promotes bf16 activations before the dot, which
+    materializes an fp32 [T, d] array in the forward and an fp32 [T, d]
+    cotangent + downcast chain in the backward — pure residual-stream
+    bandwidth charged to the router region. A mixed-precision
+    ``lax.dot_general`` with ``preferred_element_type=f32`` produces
+    bit-identical logits (bf16 values are exactly representable in fp32, so
+    promoting per-element inside the MXU pass changes nothing) with no
+    promoted operand in the program.
+
+    ``compute_dtype`` None/fp32 keeps that exact contract (ST-MoE fp32
+    router). bf16 casts BOTH operands to bf16 — halved logits-matmul read
+    traffic, still fp32 accumulation via ``preferred_element_type`` — and is
+    the opt-in ``router_dtype`` A/B; softmax/top-k stay fp32 downstream
+    either way.
+
+    Param path/init match ``nn.Dense(name="router")`` exactly ("kernel",
+    lecun_normal, fp32), so checkpoints and the ``router/kernel`` sharding
+    rules are unaffected.
+    """
+
+    features: int
+    compute_dtype: Any = None  # None/f32 -> exact mixed dot; bf16 -> bf16 dot
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), jnp.float32)
+        cdt = self.compute_dtype
+        if cdt is not None and cdt != jnp.float32:
+            x = x.astype(cdt)
+            kernel = kernel.astype(cdt)
+        return jax.lax.dot_general(
+            x, kernel, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+class RoutingStats(NamedTuple):
+    """Compact-index routing bookkeeping shared by dispatch/aux/telemetry.
+
+    Everything is int32/bool and [E]- or [k·T]-shaped — the fp32 one-hot
+    position chain, the aux-loss top-1 fraction, and the load-entropy
+    telemetry all derive from these instead of materializing [T, E] masks.
+    """
+
+    counts: jax.Array      # [E] assignments per expert (pre-capacity)
+    starts: jax.Array      # [E] exclusive-cumsum segment starts
+    order: jax.Array       # [k·T] stable argsort of (choice, token) by expert
+    pos: jax.Array         # [T, k] position within the expert's queue
+    within_cap: jax.Array  # [T, k] bool, pos < capacity
+
+
+def routing_stats(expert_idx, num_experts: int, capacity: int) -> RoutingStats:
+    """One stable argsort + bincount over ``expert_idx`` -> shared stats.
+
+    Flattens the (choice, token) pairs in the priority order (index
+    j = k_idx*T + t: all k=0 choices for tokens 0..T-1, then k=1) and
+    stable-argsorts by expert id; the within-queue position — rank in
+    sorted order minus the expert's segment start — equals the legacy
+    [k·T, E] one-hot-cumsum position exactly, drop for drop (stable sort
+    preserves the priority order within each expert's run).
+    """
+    T, k = expert_idx.shape
+    e_flat = expert_idx.T.reshape(-1).astype(jnp.int32)         # [kT]
+    order = jnp.argsort(e_flat, stable=True)                    # [kT]
+    sorted_e = e_flat[order]
+    counts = jnp.bincount(e_flat, length=num_experts).astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    # Routing index vectors are O(E) and O(k·T) ints — pin them replicated
+    # so sharding propagation (backward from the expert-sharded dispatch)
+    # can never turn `starts[sorted_e]` into a sharded-operand gather
+    # (miscompiled by the jax 0.4.x SPMD partitioner; see MoEBlock._combine).
+    counts = mesh_lib.constrain(counts, P(None))
+    starts = mesh_lib.constrain(starts, P(None))
+    pos_sorted = (jnp.arange(k * T, dtype=jnp.int32) - starts[sorted_e])
+    # Invert the permutation to per-(token, choice) positions.
+    pos_flat = jnp.zeros((k * T,), jnp.int32).at[order].set(
+        pos_sorted, unique_indices=True)
+    pos = pos_flat.reshape(k, T).T                              # [T, k]
+    within_cap = pos < capacity
+    return RoutingStats(counts, starts, order, pos, within_cap)
+
+
 class MoEBlock(nn.Module):
     """Router + expert FFNs; drop-in replacement for a dense MLP block.
 
-    Dispatch implementations, equivalence-tested against each other:
+    Dispatch implementations, equivalence-tested against each other (all
+    three consume the shared ``routing_stats`` positions):
 
-    - ``"sort"`` (recommended; MegaBlocks-style reformulation): stable-argsort
-      the (token, choice) pairs by expert id, recover per-expert segment
-      offsets from the sorted order, and take the first ``capacity`` entries
-      of each expert's contiguous run as the ``[E, C, d]`` dispatch. Index
-      work is O(T·k log T·k) sort + O(T·k) segment arithmetic — no
-      ``[T, k, E]`` one-hot mask, no ``k·T × E`` cumsum, no ``E·C``-slot
-      scatter. Same capacity-overflow drop semantics (stable sort preserves
-      the priority order within each expert queue).
+    - ``"sort"`` (recommended; MegaBlocks-style reformulation): read
+      per-expert queues as contiguous runs of the stats' stable-argsort
+      order and take the first ``capacity`` entries of each run as the
+      ``[E, C, d]`` dispatch. Index work is the shared O(T·k log T·k) sort +
+      O(T·k) segment arithmetic — no ``E·C``-slot scatter.
     - ``"gather"``: scatter token ids into an ``[E*C]`` slot table, gather
       token vectors into ``[E, C, d]``, gather expert outputs back by slot.
-      Computes queue positions via a ``[k·T, E]`` one-hot cumsum. Memory
-      O(E*C*d + T*k); index work O(T·k·E).
+      Memory O(E*C*d + T*k).
     - ``"einsum"``: the GShard/Switch formulation with an explicit
       ``[T, E, C]`` dispatch/combine mask. O(T*E*C) memory; kept because its
       einsums partition very predictably under GSPMD (useful oracle and
       fallback).
+
+    ``router_dtype`` sets the logits-matmul precision (``RouterDense``):
+    None/fp32 is the exact ST-MoE contract and the default; bf16 halves the
+    matmul's read traffic with fp32 accumulation, parity-bounded in
+    tests/test_moe_router.py. Softmax/top-k/logsumexp are always fp32.
+
+    ``router_impl`` selects the softmax+top-k+gates computation:
+    ``"reference"`` (default; plain XLA fp32 chain) or ``"fused"`` (the
+    single-pass Pallas kernel in ops/fused_router.py — one VMEM-resident
+    pass over the [T, E] logits, interpret-mode validated on CPU). Both
+    produce identical routing decisions; ``fused`` stays opt-in until a
+    chip A/B (PROFILE_MOE.md hooks).
 
     ``combine_dtype`` sets the precision of the output combine (the
     slot-gather of expert outputs + the ``tk,tkd->td`` gate einsum). It
@@ -84,7 +189,7 @@ class MoEBlock(nn.Module):
     The combine is pure bandwidth (its FLOPs are negligible; the gather of
     ``[T, k, d]`` expert outputs dominates), so running it in bf16 halves
     its HBM traffic; accumulation stays fp32 via
-    ``preferred_element_type``. Router logits/softmax/top-k are always fp32.
+    ``preferred_element_type``.
     """
 
     num_experts: int
@@ -97,6 +202,8 @@ class MoEBlock(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     combine_dtype: Any = None  # None -> fp32 (exact); bf16 halves combine BW
+    router_dtype: Any = None   # None -> fp32 logits matmul (exact); bf16 A/B
+    router_impl: str = "reference"  # "reference" | "fused" (Pallas)
 
     @nn.compact
     def __call__(self, x, train: bool = True):  # x: [B, S, d]
@@ -106,66 +213,76 @@ class MoEBlock(nn.Module):
         T = B * S
         capacity = max(int(self.capacity_factor * T * self.top_k / E), 1)
 
-        # Router in fp32 (standard for stability).
+        # Router logits in fp32 accumulation (standard for stability); the
+        # softmax/top-k decision chain is always fp32.
         with jax.named_scope("moe_router"):
-            router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
-                                     param_dtype=jnp.float32,
-                                     name="router")(tokens.astype(jnp.float32))
-            probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
+            router_logits = RouterDense(
+                E, self.router_dtype, name="router")(tokens)        # [T, E]
+            if self.router_impl == "fused":
+                from pytorch_distributed_training_example_tpu.ops import (
+                    fused_router as fused_router_lib)
 
-            # Top-k expert choice per token.
-            gate_vals, expert_idx = jax.lax.top_k(probs, self.top_k)  # [T, k]
-            gate_vals = gate_vals / jnp.maximum(
-                gate_vals.sum(-1, keepdims=True), 1e-9)
+                gate_vals, expert_idx, router_lse, router_me = (
+                    fused_router_lib.fused_router(router_logits, self.top_k))
+                probs = None
+            elif self.router_impl == "reference":
+                probs = jax.nn.softmax(router_logits, axis=-1)      # [T, E]
+                # Top-k expert choice per token.
+                gate_vals, expert_idx = jax.lax.top_k(
+                    probs, self.top_k)                              # [T, k]
+                gate_vals = gate_vals / jnp.maximum(
+                    gate_vals.sum(-1, keepdims=True), 1e-9)
+                router_lse = router_me = None
+            else:
+                raise ValueError(
+                    f"unknown router_impl {self.router_impl!r}; "
+                    "have ['reference', 'fused']")
+
+        with jax.named_scope("moe_dispatch"):
+            stats = routing_stats(expert_idx, E, capacity)
+            gate_vals = gate_vals * stats.within_cap
+            # Telemetry (ST-MoE router diagnostics): fraction of
+            # (token, choice) assignments beyond expert capacity — exact
+            # from the shared [E] counts, no mask re-materialized. sow is a
+            # no-op unless the step runs with the "telemetry" collection
+            # mutable (utils/telemetry health pack), and XLA DCEs the
+            # unused reduction in that case.
+            kept = jnp.sum(jnp.minimum(stats.counts, capacity))
+            self.sow("telemetry", "moe_drop_fraction",
+                     1.0 - kept.astype(jnp.float32) / (T * self.top_k))
 
         if self.dispatch_impl == "sort":
-            out = self._sort_route(tokens, expert_idx, gate_vals, capacity)
+            out = self._sort_route(tokens, expert_idx, stats, gate_vals,
+                                   capacity)
+        elif self.dispatch_impl == "einsum":
+            out = self._einsum_route(tokens, expert_idx, stats, gate_vals,
+                                     capacity)
         else:
-            with jax.named_scope("moe_dispatch"):
-                # Capacity bucketing: position of each token within its
-                # expert queue, via the [k·T, E] one-hot cumsum.
-                onehot = jax.nn.one_hot(expert_idx, E,
-                                        dtype=jnp.float32)  # [T, k, E]
-                # priority: earlier tokens first, k=0 choices before k=1
-                flat = onehot.transpose(1, 0, 2).reshape(self.top_k * T, E)
-                pos_in_expert = jnp.cumsum(flat, axis=0) - flat     # [kT, E]
-                pos = (pos_in_expert.reshape(self.top_k, T, E)
-                       .transpose(1, 0, 2) * onehot).sum(-1)        # [T, k]
-                within_cap = pos < capacity
-                gate_vals = gate_vals * within_cap
-                # Telemetry (ST-MoE router diagnostics): fraction of
-                # (token, choice) assignments beyond expert capacity. sow is
-                # a no-op unless the step runs with the "telemetry"
-                # collection mutable (utils/telemetry health pack), and XLA
-                # DCEs the unused mean in that case.
-                self.sow("telemetry", "moe_drop_fraction",
-                         1.0 - jnp.mean(within_cap.astype(jnp.float32)))
-
-            if self.dispatch_impl == "einsum":
-                out = self._einsum_route(tokens, onehot, pos, within_cap,
-                                         gate_vals, capacity)
-            else:
-                out = self._gather_route(tokens, expert_idx, pos, within_cap,
-                                         gate_vals, capacity)
+            out = self._gather_route(tokens, expert_idx, stats, gate_vals,
+                                     capacity)
 
         with jax.named_scope("moe_aux"):
             # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
-            me = probs.mean(0)                            # mean router prob
-            ce = jax.nn.one_hot(expert_idx[:, 0], E,
-                                dtype=jnp.float32).mean(0)  # top-1 routed frac
+            # The gradient flows only through me (counts are int-derived),
+            # so the compact ce is exactly gradient-equivalent to the
+            # one-hot mean it replaces.
+            me = router_me if router_me is not None else probs.mean(0)
+            top1 = jnp.bincount(expert_idx[:, 0].astype(jnp.int32), length=E)
+            top1 = mesh_lib.constrain(top1, P(None))
+            ce = top1.astype(jnp.float32) / T           # top-1 routed frac
             aux = E * jnp.sum(me * ce)
             self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
             # Router z-loss (ST-MoE): keeps logits from drifting to
             # magnitudes where fp32 softmax saturates.
-            z = jnp.mean(
-                jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+            lse = (router_lse if router_lse is not None else
+                   jax.scipy.special.logsumexp(router_logits, axis=-1))
+            z = jnp.mean(lse ** 2)
             self.sow("losses", "moe_z_loss", self.z_loss_weight * z)
             # Telemetry: entropy of the routed-load distribution over all k
             # choices (pre-capacity), normalized by ln(E) so 1.0 = perfectly
-            # balanced, 0.0 = collapsed onto one expert. Sown under the
-            # "telemetry" collection — free unless the health pack is on.
-            load = jax.nn.one_hot(expert_idx, E,
-                                  dtype=jnp.float32).mean((0, 1))  # [E]
+            # balanced, 0.0 = collapsed onto one expert. Shares the [E]
+            # counts with dispatch — zero extra router-region traffic.
+            load = stats.counts.astype(jnp.float32) / (T * self.top_k)
             ent = -jnp.sum(load * jnp.log(load + 1e-9)) / jnp.log(float(E))
             self.sow("telemetry", "router_load_entropy", ent)
 
@@ -203,68 +320,35 @@ class MoEBlock(nn.Module):
             return jnp.einsum("tk,tkd->td", gate_vals.astype(cdt), y,
                               preferred_element_type=jnp.float32)
 
-    def _sort_route(self, tokens, expert_idx, gate_vals, capacity):
+    def _sort_route(self, tokens, expert_idx, stats, gate_vals, capacity):
         """Sort-based dispatch (MegaBlocks-style, capacity-dropped).
 
-        Flattens the (choice, token) pairs in the legacy priority order
-        (index j = k_idx*T + t: all k=0 choices for tokens 0..T-1, then
-        k=1), stable-argsorts by expert id, and reads per-expert queues as
-        contiguous runs of the sorted order. Stable sort preserves the
-        priority order within each expert, so the within-queue position —
-        rank in sorted order minus the expert's segment start — equals the
-        one-hot-cumsum position of the gather/einsum paths exactly, drop
-        for drop.
+        Expert e's queue = sorted entries [starts[e], starts[e]+C) of the
+        shared stats order: one [E, C] take of token rows — no E*C scatter,
+        no [T, k, E] mask. Overflow entries (c >= counts[e]) read the zero
+        row T.
         """
         T, d = tokens.shape
         E = self.num_experts
         k = self.top_k
         n_slots = E * capacity
         with jax.named_scope("moe_dispatch"):
-            e_flat = expert_idx.T.reshape(-1).astype(jnp.int32)     # [kT]
-            order = jnp.argsort(e_flat, stable=True)                # [kT]
-            sorted_e = e_flat[order]
-            counts = jnp.bincount(e_flat, length=E).astype(jnp.int32)
-            starts = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-            # Routing index vectors are O(E) and O(k·T) ints — pin them
-            # replicated so sharding propagation (backward from the
-            # expert-sharded dispatch) can never turn `starts[sorted_e]`
-            # into a sharded-operand gather (miscompiled by the jax 0.4.x
-            # SPMD partitioner; see _combine).
-            counts = mesh_lib.constrain(counts, P(None))
-            starts = mesh_lib.constrain(starts, P(None))
-            pos_sorted = (jnp.arange(k * T, dtype=jnp.int32)
-                          - starts[sorted_e])
-            # Invert the permutation to per-(token, choice) positions.
-            pos_flat = jnp.zeros((k * T,), jnp.int32).at[order].set(
-                pos_sorted, unique_indices=True)
-            pos = pos_flat.reshape(k, T).T                          # [T, k]
-            within_cap = pos < capacity
-            gate_vals = gate_vals * within_cap
-            # Same telemetry scalar as the gather/einsum path (positions are
-            # drop-for-drop identical across dispatch impls).
-            self.sow("telemetry", "moe_drop_fraction",
-                     1.0 - jnp.mean(within_cap.astype(jnp.float32)))
-
-            # Expert e's queue = sorted entries [starts[e], starts[e]+C):
-            # one [E, C] take of token rows — no E*C scatter, no [T,k,E]
-            # mask. Overflow entries (c >= counts[e]) read the zero row T.
-            tok_flat = (order % T).astype(jnp.int32)
-            take = starts[:, None] + jnp.arange(capacity,
-                                                dtype=jnp.int32)[None, :]
-            valid = jnp.arange(capacity)[None, :] < counts[:, None]  # [E, C]
+            tok_flat = (stats.order % T).astype(jnp.int32)
+            take = stats.starts[:, None] + jnp.arange(
+                capacity, dtype=jnp.int32)[None, :]
+            valid = (jnp.arange(capacity)[None, :]
+                     < stats.counts[:, None])                    # [E, C]
             tok_for_slot = jnp.where(
                 valid, tok_flat[jnp.minimum(take, k * T - 1)], T)
             tokens_pad = jnp.concatenate(
-                [tokens, jnp.zeros((1, d), tokens.dtype)])          # row T = 0
+                [tokens, jnp.zeros((1, d), tokens.dtype)])       # row T = 0
             dispatched = tokens_pad[tok_for_slot].astype(self.dtype)
         expert_out = self._experts(dispatched)
-        slot = jnp.where(within_cap,
-                         expert_idx * capacity + pos, n_slots)      # [T, k]
+        slot = jnp.where(stats.within_cap,
+                         expert_idx * capacity + stats.pos, n_slots)  # [T, k]
         return self._combine(expert_out, slot, gate_vals, n_slots)
 
-    def _gather_route(self, tokens, expert_idx, pos, within_cap, gate_vals,
-                      capacity):
+    def _gather_route(self, tokens, expert_idx, stats, gate_vals, capacity):
         T, d = tokens.shape
         E = self.num_experts
         n_slots = E * capacity
@@ -272,8 +356,8 @@ class MoEBlock(nn.Module):
             # Each kept (token, choice) owns one slot; the trash row (index
             # n_slots) absorbs dropped tokens. Slots are unique per expert
             # queue position, so the scatter has no collisions.
-            slot = jnp.where(within_cap,
-                             expert_idx * capacity + pos.astype(jnp.int32),
+            slot = jnp.where(stats.within_cap,
+                             expert_idx * capacity + stats.pos,
                              n_slots)                               # [T, k]
             tok_ids = jnp.broadcast_to(
                 jnp.arange(T, dtype=jnp.int32)[:, None], slot.shape)
@@ -287,14 +371,19 @@ class MoEBlock(nn.Module):
         expert_out = self._experts(dispatched)
         return self._combine(expert_out, slot, gate_vals, n_slots)
 
-    def _einsum_route(self, tokens, onehot, pos, within_cap, gate_vals,
-                      capacity):
+    def _einsum_route(self, tokens, expert_idx, stats, gate_vals, capacity):
+        E = self.num_experts
         with jax.named_scope("moe_dispatch"):
-            # Dispatch mask [T, k, E, C] -> combined [T, E, C].
-            cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                        dtype=jnp.float32)  # [T,k,C]
-            dispatch = jnp.einsum("tke,tkc->tec", onehot,
-                                  cap_onehot * within_cap[..., None])
+            # The explicit-mask formulation IS this impl's definition: the
+            # one-hots here are its dispatch/combine operands, built from
+            # the shared stats positions (not a second position chain).
+            onehot = jax.nn.one_hot(expert_idx, E,
+                                    dtype=jnp.float32)              # [T,k,E]
+            cap_onehot = jax.nn.one_hot(stats.pos, capacity,
+                                        dtype=jnp.float32)          # [T,k,C]
+            dispatch = jnp.einsum(
+                "tke,tkc->tec", onehot,
+                cap_onehot * stats.within_cap[..., None])
             combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
                                  gate_vals)
             dispatched = jnp.einsum(

@@ -52,6 +52,8 @@ class Config:
     moe_capacity_factor: float = 1.25
     moe_dispatch_impl: str = "gather"  # sort | gather | einsum
     moe_combine_dtype: str = "fp32"  # fp32 (exact) | bf16 (combine-BW A/B)
+    moe_router_dtype: str = "fp32"  # fp32 (ST-MoE exact) | bf16 (matmul A/B)
+    moe_router_impl: str = "reference"  # reference | fused (Pallas kernel)
     pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)
     strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)

@@ -94,6 +94,12 @@ def collect_sowed(tele_vars) -> dict[str, jax.Array]:
     Sow appends one entry per call site per layer (tuples; a leading scan
     dim when layers are scanned) — group leaves by their final name and
     average, so ``router_load_entropy`` is the mean over all MoE layers.
+
+    Since the r8 router round both MoE sows (``moe_drop_fraction``,
+    ``router_load_entropy``) derive from the SAME compact [E] routing
+    counts the dispatch uses (``parallel/moe.py routing_stats``) — they
+    are exact token counts, not a second mask-based estimate, and cost no
+    extra [T, E] materialization in the step.
     """
     out: dict[str, list] = {}
     flat = jax.tree_util.tree_flatten_with_path(tele_vars)[0]

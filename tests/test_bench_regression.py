@@ -67,3 +67,122 @@ def test_cli_handles_driver_wrapper(tmp_path):
 def test_real_golden_file_loads():
     golden = cr.load_golden()
     assert "TPU v5 lite" in golden
+
+
+# ---- --aot-bytes: per-region AOT modeled-byte gate (r8) ----
+
+AOT_GOLDEN = {"aot_regions": {"llama_moe b4 s2048 gather": {
+    "backend_lowering": "cpu",
+    "attribution": "proportional_bytes",
+    "regions": {"moe_router": 50.0, "moe_experts": 170.0},
+}}}
+
+
+def _aot_result(router=50.0, experts=170.0, backend="cpu",
+                attribution="proportional_bytes"):
+    return {
+        "mode": "aot_hlo_model", "attribution": attribution,
+        "backend_lowering": backend, "model": "llama_moe",
+        "per_chip_batch": 4, "seq_len": 2048,
+        "moe_dispatch_impl": "gather",
+        "regions": {"moe_router": {"gbytes_modeled": router},
+                    "moe_experts": {"gbytes_modeled": experts}},
+    }
+
+
+def test_aot_bytes_ok_and_shrink_pass():
+    failures, report = cr.check_aot_bytes(_aot_result(router=20.0),
+                                          AOT_GOLDEN)
+    assert not failures
+    assert sum(line.startswith("OK") for line in report) == 2
+
+
+def test_aot_bytes_growth_fails():
+    """Bytes regress UPWARD: +10% is the gate, +20% must fail."""
+    failures, _ = cr.check_aot_bytes(_aot_result(router=60.0), AOT_GOLDEN)
+    assert len(failures) == 1 and "moe_router" in failures[0]
+    failures, _ = cr.check_aot_bytes(_aot_result(router=54.9), AOT_GOLDEN)
+    assert not failures
+
+
+def test_aot_bytes_no_golden_reports_not_fails():
+    res = _aot_result()
+    res["moe_dispatch_impl"] = "sort"  # different key -> no golden entry
+    failures, report = cr.check_aot_bytes(res, AOT_GOLDEN)
+    assert not failures
+    assert report and report[0].startswith("NO-GOLDEN")
+
+
+def test_aot_bytes_skips_on_model_mismatch():
+    """Goldens are lowering- and attribution-model-specific: numbers from
+    a different backend or byte-attribution scheme never compare."""
+    for kw in ({"backend": "tpu"}, {"attribution": "line_majority"}):
+        failures, report = cr.check_aot_bytes(
+            _aot_result(router=999.0, **kw), AOT_GOLDEN)
+        assert not failures
+        assert report and report[0].startswith("SKIP")
+
+
+def test_aot_bytes_record_then_check_cli(tmp_path):
+    """--record writes the golden, a second invocation gates against it;
+    a grown region then fails with exit code 1."""
+    golden_path = tmp_path / "golden.json"
+    golden_path.write_text(json.dumps({"_comment": "test"}))
+    import importlib
+    res_file = tmp_path / "aot.json"
+    res_file.write_text(json.dumps(_aot_result()))
+    cr.record_aot_golden(json.loads(res_file.read_text()), str(golden_path))
+    golden = json.loads(golden_path.read_text())
+    assert "_comment" in golden  # comment keys survive the rewrite
+    key = "llama_moe b4 s2048 gather"
+    assert golden["aot_regions"][key]["regions"]["moe_router"] == 50.0
+    ok, _ = cr.check_aot_bytes(_aot_result(),
+                               cr.load_golden(str(golden_path)))
+    assert not ok
+    bad, _ = cr.check_aot_bytes(_aot_result(router=70.0),
+                                cr.load_golden(str(golden_path)))
+    assert len(bad) == 1
+
+
+def test_real_golden_has_aot_regions():
+    """The bench-shape golden this round recorded (PROFILE_MOE.md r8)."""
+    entry = cr.load_golden()["aot_regions"]["llama_moe b4 s2048 gather"]
+    assert entry["attribution"] == "proportional_bytes"
+    assert entry["regions"]["moe_router"] < 60.0  # the corrected number
+
+
+# ---- proportional fusion attribution (profile_step.build_op_moe_weights) --
+
+SYNTH_HLO = """\
+HloModule synth
+
+%fused_computation.1 (param_0: f32[8]) -> f32[24] {
+  %param_0 = f32[8]{0} parameter(0)
+  %a.1 = f32[8]{0} add(%param_0, %param_0), metadata={op_name="jit(f)/moe_router/add"}
+  ROOT %b.1 = f32[24]{0} multiply(%a.1, %a.1), metadata={op_name="jit(f)/other"}
+}
+
+ENTRY %main (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  %fusion.1 = f32[24]{0} fusion(%p), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(f)/other"}
+  ROOT %t = f32[8]{0} tanh(%p), metadata={op_name="jit(f)/moe_aux/tanh"}
+}
+"""
+
+
+def test_moe_weights_split_mixed_fusion():
+    """A fusion whose interior is 25% router bytes (32 of 128) charges the
+    router exactly that fraction; the untagged remainder is unassigned.
+    Tagged non-fusion ops keep weight 1.0. The winner-take-all map
+    (build_op_moe_tags) would have charged this fusion 100% to the router
+    — the r7 mega-fusion misattribution this model corrects."""
+    import profile_step as ps
+
+    w = ps.build_op_moe_weights(SYNTH_HLO)
+    assert w["fusion.1"] == {"moe_router": 32.0 / 128.0}
+    assert w["t"] == {"moe_aux": 1.0}
+    # the interior tagged line is itself weighted (its own op_bytes exist)
+    assert w["a.1"] == {"moe_router": 1.0}
+    # contrast: the line-majority map attributes the whole fusion
+    tags = ps.build_op_moe_tags(SYNTH_HLO)
+    assert tags["fusion.1"] == "moe_router"
