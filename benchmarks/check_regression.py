@@ -98,6 +98,39 @@ def check(result: dict, golden: dict, tolerance: float = 0.10):
     return failures, report
 
 
+def check_goodput(path: str, min_coverage: float = 0.95):
+    """Gate a run's ``goodput.json`` on instrumentation coverage.
+
+    Accepts both single-attempt files and the merged multi-attempt files an
+    elastic/supervisor run writes (``attempts`` > 1, with the inter-attempt
+    gap folded into the ``restart`` badput bucket). The gate is on cumulative
+    ``coverage`` — spans must explain at least ``min_coverage`` of the total
+    wall clock across every attempt, so a restart tax that the telemetry
+    failed to attribute shows up as a failure rather than vanishing.
+    """
+    failures, report = [], []
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+        coverage = float(data["coverage"])
+        wall = float(data["wall_s"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        msg = f"goodput {path}: unreadable or malformed ({e})"
+        failures.append(msg)
+        report.append("MALFORMED " + msg)
+        return failures, report
+    attempts = int(data.get("attempts", 1))
+    restart_s = float(data.get("categories_s", {}).get("restart", 0.0))
+    line = (f"goodput {path}: coverage {coverage:.3f} over {wall:.1f}s wall, "
+            f"{attempts} attempt(s), restart tax {restart_s:.1f}s")
+    if coverage < min_coverage:
+        failures.append(line + f" — below floor {min_coverage}")
+        report.append("REGRESSION " + line + f" (floor {min_coverage})")
+    else:
+        report.append("OK " + line)
+    return failures, report
+
+
 def aot_key(result: dict) -> str:
     """Golden key for an aot_report: model + shape + dispatch formulation."""
     return (f"{result['model']} b{result['per_chip_batch']} "
@@ -171,6 +204,11 @@ def main(argv=None):
                    help="also scan this run's metrics.jsonl for non-finite "
                         "training-health scalars (telemetry rows); any hit "
                         "fails the gate")
+    p.add_argument("--goodput", default=None, metavar="GOODPUT_JSON",
+                   help="also gate this run's goodput.json on span coverage "
+                        "(cumulative across supervisor attempts for elastic "
+                        "runs); fails below --goodput-min-coverage")
+    p.add_argument("--goodput-min-coverage", type=float, default=0.95)
     p.add_argument("--aot-bytes", action="store_true",
                    help="input is a profile_step.py --aot report: gate "
                         "per-region modeled bytes (UP is the regression "
@@ -197,10 +235,10 @@ def main(argv=None):
         for line in report:
             print(line)
         return 1 if failures else 0
-    # --metrics-jsonl alone is a health-only scan (no bench row expected on
-    # stdin); a positional result file, or plain piped usage, still runs the
-    # golden comparison.
-    if args.result or not args.metrics_jsonl:
+    # --metrics-jsonl / --goodput alone are standalone scans (no bench row
+    # expected on stdin); a positional result file, or plain piped usage,
+    # still runs the golden comparison.
+    if args.result or not (args.metrics_jsonl or args.goodput):
         raw = open(args.result).read() if args.result else sys.stdin.read()
         # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result
         # under "parsed") or piped bench.py output (last stdout line is the
@@ -215,6 +253,11 @@ def main(argv=None):
         h_failures, h_report = check_health(args.metrics_jsonl)
         failures += h_failures
         report += h_report
+    if args.goodput:
+        g_failures, g_report = check_goodput(args.goodput,
+                                             args.goodput_min_coverage)
+        failures += g_failures
+        report += g_report
     for line in report:
         print(line)
     return 1 if failures else 0

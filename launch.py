@@ -10,8 +10,7 @@ testing and for explicit on-host pods:
 spawns N processes with COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID set
 (plus per-process CPU device partitioning when --cpu-devices is given),
 streams rank-0 output, and propagates the first non-zero exit — torchrun's
-contract, minus elasticity (TPU slices are gang-scheduled; recovery is
-restart-from-checkpoint, SURVEY.md §5 failure detection).
+contract, including elasticity (``--elastic``, below).
 
 Supervisor mode (``--restart-policy``): when a run exits with the distinct
 preemption code (resilience.PREEMPTED_EXIT_CODE — the trainer's
@@ -20,6 +19,16 @@ with any failure under ``on-failure``, the whole gang is relaunched with
 ``--resume auto`` appended, up to ``--max-restarts`` times with exponential
 backoff. This is the "gang-scheduled slices get preempted and restart from
 the latest checkpoint" recovery loop, run locally.
+
+Elastic mode (``--elastic MIN[:MAX]``): before each restart the supervisor
+reads the dead-host records (``dead_hosts.jsonl`` in the child's
+``--checkpoint-dir``, written by an abruptly dying attempt — chaos
+``kill_host`` or a real hard failure) and relaunches at the surviving world
+size instead of the original one. The abrupt host-loss exit code
+(resilience.HOST_LOST_EXIT_CODE) is restartable under any restart policy
+when ``--elastic`` is set. Below MIN the supervisor gives up; the trainer
+side (``main.py --elastic``) rebuilds the mesh at the new size and rescales
+the batch geometry under ``--elastic-policy`` (utils/elastic.py).
 """
 
 from __future__ import annotations
@@ -33,11 +42,17 @@ import sys
 import time
 
 try:
-    # resilience.py deliberately imports no jax — safe in the launcher.
+    # resilience.py / elastic.py deliberately import no jax — safe here.
     from pytorch_distributed_training_example_tpu.utils.resilience import (
-        PREEMPTED_EXIT_CODE)
+        HOST_LOST_EXIT_CODE, PREEMPTED_EXIT_CODE)
+    from pytorch_distributed_training_example_tpu.utils.elastic import (
+        read_dead_hosts)
 except ImportError:  # stripped deployments: keep the launcher standalone
     PREEMPTED_EXIT_CODE = 75
+    HOST_LOST_EXIT_CODE = 76
+
+    def read_dead_hosts(directory):
+        return set()
 
 
 def free_port() -> int:
@@ -46,14 +61,47 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def probe_port(port: int) -> bool:
+    """True when ``port`` is actually bindable right now."""
+    try:
+        with socket.socket() as s:
+            s.bind(("", port))
+        return True
+    except OSError:
+        return False
+
+
+def coordinator_port(preferred: int | None) -> int:
+    """Pick a bindable coordinator port, preferring the configured one.
+
+    A supervisor restart previously burned a whole restart-budget attempt on
+    EADDRINUSE when the preferred port (or the freshly allocated one, in a
+    rare close-to-spawn race) was still held — e.g. the dying attempt's
+    socket lingering outside TIME_WAIT, or another job grabbing it. Probe
+    before spawning children and fall back to a fresh port with a warning
+    instead.
+    """
+    candidates = ([preferred] if preferred else []) + \
+        [free_port() for _ in range(3)]
+    for i, port in enumerate(candidates):
+        if probe_port(port):
+            if i > 0 and preferred:
+                print(f"launch.py: coordinator port {preferred} is not "
+                      f"bindable — using {port} instead", file=sys.stderr)
+            return port
+    raise OSError(
+        f"no bindable coordinator port found (tried {candidates})")
+
+
 _interrupted = False
 
 
 def run_once(args, cmd) -> int:
     """Spawn the gang once, poll all ranks, return the first failure code."""
     # Fresh port per attempt: the previous attempt's coordinator socket can
-    # linger in TIME_WAIT and wedge the rendezvous of a restart.
-    port = args.coordinator_port or free_port()
+    # linger in TIME_WAIT and wedge the rendezvous of a restart. Probed for
+    # bindability so a held port costs a warning, not a restart attempt.
+    port = coordinator_port(args.coordinator_port)
     procs = []
     for rank in range(args.nprocs):
         env = os.environ.copy()
@@ -113,6 +161,26 @@ def run_once(args, cmd) -> int:
     return code
 
 
+def parse_elastic(spec: str) -> tuple[int, int]:
+    """``MIN`` or ``MIN:MAX`` -> (min_world, max_world)."""
+    lo, _, hi = spec.partition(":")
+    min_world = int(lo)
+    max_world = int(hi) if hi else 1 << 30
+    if min_world < 1 or max_world < min_world:
+        raise ValueError(f"--elastic expects MIN[:MAX] with 1 <= MIN <= MAX, "
+                         f"got {spec!r}")
+    return min_world, max_world
+
+
+def find_flag(cmd: list[str], flag: str) -> str | None:
+    """Value of ``flag <value>`` in the child command line (last wins)."""
+    value = None
+    for i, tok in enumerate(cmd[:-1]):
+        if tok == flag:
+            value = cmd[i + 1]
+    return value
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nprocs", type=int, default=2)
@@ -132,26 +200,71 @@ def main(argv=None):
                    help="restart budget for the supervisor (per launcher run)")
     p.add_argument("--restart-backoff", type=float, default=1.0,
                    help="base seconds between restarts; doubles per restart")
+    p.add_argument("--elastic", default=None, metavar="MIN[:MAX]",
+                   help="elastic supervisor: on restart, shrink the world to "
+                        "the surviving host set (dead_hosts.jsonl in the "
+                        "child's --checkpoint-dir) instead of relaunching "
+                        "the full gang; give up below MIN hosts. Makes the "
+                        f"abrupt host-loss exit ({HOST_LOST_EXIT_CODE}) "
+                        "restartable under any restart policy")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- script.py args...")
     args = p.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
+    elastic = None
+    if args.elastic is not None:
+        if args.restart_policy == "never":
+            p.error("--elastic needs a restart policy (on-preempt or "
+                    "on-failure): shrinking happens at relaunch")
+        try:
+            elastic = parse_elastic(args.elastic)
+        except ValueError as e:
+            p.error(str(e))
     os.makedirs(args.log_dir, exist_ok=True)
+
+    # The elastic "world" is whichever knob actually multiplexes hosts in
+    # this launch: real processes when --nprocs > 1, else fake CPU devices
+    # (the single-process local pod used by tests and dryrun drills).
+    world_attr = "nprocs" if args.nprocs > 1 else "cpu_devices"
+    dead_seen: set[int] = set()
 
     restarts = 0
     while True:
         code = run_once(args, cmd)
-        if code == 0 or args.restart_policy == "never" or _interrupted:
+        if code == 0 or _interrupted:
             return code
-        if args.restart_policy == "on-preempt" and code != PREEMPTED_EXIT_CODE:
+        restartable = (args.restart_policy == "on-failure"
+                       or (args.restart_policy == "on-preempt"
+                           and code == PREEMPTED_EXIT_CODE)
+                       or (elastic is not None
+                           and code == HOST_LOST_EXIT_CODE))
+        if args.restart_policy == "never" or not restartable:
             return code
         if restarts >= args.max_restarts:
             print(f"launch.py: restart budget exhausted "
                   f"({args.max_restarts}); last exit code {code}",
                   file=sys.stderr)
             return code
+        if elastic is not None:
+            ckdir = find_flag(cmd, "--checkpoint-dir")
+            new_dead = (read_dead_hosts(ckdir) - dead_seen) if ckdir else set()
+            if new_dead:
+                dead_seen |= new_dead
+                world = getattr(args, world_attr) or 1
+                min_world, max_world = elastic
+                new_world = min(max(world - len(new_dead), 0), max_world)
+                if new_world < min_world:
+                    print(f"launch.py: elastic give-up — {len(new_dead)} "
+                          f"host(s) {sorted(new_dead)} lost, surviving world "
+                          f"{new_world} is below --elastic min {min_world}",
+                          file=sys.stderr)
+                    return code
+                print(f"launch.py: elastic — host(s) {sorted(new_dead)} "
+                      f"lost, relaunching at world size {new_world} "
+                      f"(was {world})", file=sys.stderr)
+                setattr(args, world_attr, new_world)
         restarts += 1
         delay = args.restart_backoff * 2 ** (restarts - 1)
         print(f"launch.py: exit code {code} -> restart {restarts}/"

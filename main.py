@@ -105,6 +105,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "resume continues at the exact next sample)")
     p.add_argument("--resume", default=None, nargs="?", const="auto",
                    help="checkpoint dir or 'auto' (newest committed)")
+    p.add_argument("--elastic", action="store_true", default=None,
+                   help="elastic resume: accept a checkpoint written under a "
+                        "different world size — rebuild the mesh at the "
+                        "surviving device set and rescale the batch geometry "
+                        "under --elastic-policy (utils/elastic.py)")
+    p.add_argument("--elastic-policy", default=None, dest="elastic_policy",
+                   choices=["keep_global_batch", "scale_lr"],
+                   help="batch policy on a world-size change: keep the "
+                        "global batch via gradient accumulation (exact "
+                        "trajectory) or shrink/grow it with linear LR "
+                        "scaling")
     p.add_argument("--evaluate", action="store_true",
                    help="evaluation only (use with --resume to score a "
                         "checkpoint); no training")

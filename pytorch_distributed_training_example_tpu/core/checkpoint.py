@@ -190,11 +190,16 @@ class Checkpointer:
         flat = _flatten(state)
         # Snapshot synchronously: the caller will donate these buffers to the
         # next step. Each host only materializes its addressable shards.
+        # np.array (not np.asarray): asarray of a shard is a zero-copy
+        # memoryview of the device buffer, and once the caller donates the
+        # state XLA recycles that memory for activations — the background
+        # thread would then serialize garbage (with a valid CRC, since the
+        # checksum is computed over whatever bytes hit disk).
         shards: dict[str, list[tuple[list[list[int]], np.ndarray]]] = {}
         manifest_leaves: dict[str, Any] = {}
         for path, arr in flat.items():
             if isinstance(arr, np.ndarray):
-                regions = [([[0, s] for s in arr.shape], np.asarray(arr))]
+                regions = [([[0, s] for s in arr.shape], np.array(arr))]
             else:
                 regions = []
                 for sh in arr.addressable_shards:
@@ -204,12 +209,27 @@ class Checkpointer:
                         [s.start or 0, s.stop if s.stop is not None else dim]
                         for s, dim in zip(sh.index, arr.shape)
                     ] or [[0, 0]]
-                    regions.append((idx, np.asarray(sh.data)))
+                    regions.append((idx, np.array(sh.data)))
             shards[path] = regions
             manifest_leaves[path] = {
                 "shape": list(np.shape(arr)),
                 "dtype": str(np.asarray(regions[0][1]).dtype) if regions else str(arr.dtype),
             }
+
+        # Source-topology record (elastic resume): which geometry wrote this
+        # checkpoint. Restore warns loudly on mismatch instead of silently
+        # reassembling across topologies; the elastic trainer reads it via
+        # peek_manifest() to plan the batch rescale before building anything.
+        geometry: dict[str, Any] = {
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+        }
+        for arr in flat.values():
+            mesh = getattr(getattr(arr, "sharding", None), "mesh", None)
+            if mesh is not None and hasattr(mesh, "shape"):
+                geometry["mesh_shape"] = {
+                    str(k): int(v) for k, v in dict(mesh.shape).items()}
+                break
 
         step_dir = os.path.join(self.directory, f"step_{step:08d}")
         attempt_dir = step_dir + SAVING_SUFFIX
@@ -270,6 +290,7 @@ class Checkpointer:
                 manifest = {
                     "step": step,
                     "extra": extra or {},
+                    "geometry": geometry,
                     "leaves": {
                         p: {**manifest_leaves[p], "files": written.get(p, [])}
                         for p in shards
@@ -446,6 +467,7 @@ class Checkpointer:
                 return json.load(fh)
 
         manifest = resilience.retriable_io(read_manifest, _what="ckpt_read")
+        _warn_geometry_mismatch(step, manifest)
         # Union per-host file lists when present (multi-host shared fs).
         leaves = manifest["leaves"]
         for fn in os.listdir(step_dir):
@@ -658,3 +680,48 @@ def latest_checkpoint(directory: str) -> int | None:
             "checkpoint step %d in %s has a missing/unparseable manifest — "
             "treating as uncommitted and falling back", s, directory)
     return None
+
+
+def peek_manifest(directory: str, step: int | None = None) -> dict | None:
+    """JSON-only read of a committed step's manifest (no array I/O).
+
+    The elastic resume path calls this *before* the mesh/model/optimizer are
+    built, to learn the geometry (``manifest["geometry"]``, ``extra``'s
+    ``global_batch_size``/``grad_accum``/``mesh_shape``) the checkpoint was
+    written under and plan the batch rescale. ``step=None`` peeks the newest
+    usable committed step. Returns None when nothing committed/parseable —
+    advisory only, never raises for a missing checkpoint.
+    """
+    steps = ([step] if step is not None
+             else list(reversed(all_checkpoints(directory))))
+    for s in steps:
+        try:
+            with open(os.path.join(directory, f"step_{s:08d}",
+                                   MANIFEST_FILE)) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def _warn_geometry_mismatch(step: int, manifest: dict) -> None:
+    """Loud (non-fatal) warning when a checkpoint written under one topology
+    is restored under another — previously a changed world size restored
+    silently. Cross-topology restore is *supported* (shard-wise reassembly);
+    the warning exists so an unintended geometry change can't go unnoticed."""
+    geom = manifest.get("geometry") or {}
+    if not geom:
+        return  # pre-geometry checkpoint: nothing recorded to compare
+    mismatches = []
+    for key, current in (("process_count", jax.process_count()),
+                         ("device_count", jax.device_count())):
+        recorded = geom.get(key)
+        if recorded is not None and int(recorded) != current:
+            mismatches.append(f"{key} {recorded} -> {current}")
+    if mismatches:
+        log.warning(
+            "checkpoint step %d was written under a DIFFERENT topology "
+            "(%s; source mesh %s) — restoring cross-topology via shard-wise "
+            "reassembly. If this is not an intended elastic/topology change, "
+            "stop and check the checkpoint path.", step,
+            ", ".join(mismatches), geom.get("mesh_shape"))

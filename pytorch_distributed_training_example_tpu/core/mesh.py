@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import math
 import threading
 from typing import Sequence
@@ -32,6 +33,8 @@ from typing import Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("pdtx")
 
 AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "model")
 
@@ -78,6 +81,52 @@ class MeshConfig:
             )
         return tuple(sizes)
 
+    def elastic_resolve(self, num_devices: int) -> tuple[int, ...]:
+        """:meth:`resolve`, but degrade pinned axes when the device set shrank.
+
+        Elastic resume relaunches with fewer (or more) devices than the mesh
+        was configured for. A wildcard axis absorbs the change for free; when
+        the *fixed* axes no longer fit, shrink each — innermost (``model``)
+        first, since inner axes carry the latency-sensitive collectives that
+        a degraded topology can least afford — to its largest divisor that
+        still fits, and let ``data`` (or the wildcard) absorb the remainder.
+        Changes are logged loudly; the result always multiplies out to
+        ``num_devices``.
+        """
+        try:
+            return self.resolve(num_devices)
+        except ValueError:
+            pass
+        sizes = list(self.sizes())
+        wild = sizes.index(-1) if -1 in sizes else 0
+        if sizes[wild] == -1:
+            sizes[wild] = 1
+        # Shrink fixed axes innermost-first until the rest fits.
+        for i in reversed(range(len(sizes))):
+            if i == wild:
+                continue
+            others = math.prod(s for j, s in enumerate(sizes)
+                               if j != i and j != wild)
+            cap = max(1, num_devices // others)
+            sizes[i] = math.gcd(sizes[i], cap)
+        others = math.prod(s for j, s in enumerate(sizes) if j != wild)
+        if num_devices % others:
+            raise ValueError(
+                f"elastic resolve failed: fixed axes "
+                f"{dict(zip(AXES, sizes))} do not divide {num_devices} devices")
+        sizes[wild] = num_devices // others
+        resolved = tuple(sizes)
+        changed = {a: (old, new) for a, old, new
+                   in zip(AXES, self.sizes(), resolved)
+                   if old not in (-1, new)}
+        if changed:
+            log.warning(
+                "elastic mesh: %d devices cannot satisfy the configured mesh "
+                "— degraded axes %s (full shape %s)", num_devices,
+                {a: f"{o}->{n}" for a, (o, n) in changed.items()},
+                dict(zip(AXES, resolved)))
+        return resolved
+
 
 def dcn_split(shape: Sequence[int], num_slices: int) -> tuple[tuple, tuple]:
     """Split a logical mesh shape into (per-slice ICI shape, DCN shape).
@@ -104,6 +153,7 @@ def build_mesh(
     config: MeshConfig | dict | None = None,
     *,
     devices: Sequence[jax.Device] | None = None,
+    elastic: bool = False,
 ) -> Mesh:
     """Build the named device mesh.
 
@@ -121,7 +171,8 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    shape = config.resolve(len(devices))
+    shape = (config.elastic_resolve(len(devices)) if elastic
+             else config.resolve(len(devices)))
     slices = {getattr(d, "slice_index", 0) for d in devices}
     if len(slices) > 1:
         ici, dcn = dcn_split(shape, len(slices))  # config errors surface

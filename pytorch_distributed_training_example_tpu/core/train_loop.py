@@ -218,6 +218,14 @@ def make_train_step(task, grad_accum: int = 1, health: bool = False) -> Callable
             task_metrics = task.metrics(logits, batch)
         else:
             G = grad_accum
+            bad = {k: v.shape[0] for k, v in batch.items()
+                   if hasattr(v, "shape") and v.ndim and v.shape[0] % G}
+            if bad:
+                raise ValueError(
+                    f"grad_accum={G} does not divide the batch dimension of "
+                    f"{bad} — after an elastic rescale the global batch must "
+                    f"remain a multiple of grad_accum x data-parallel degree "
+                    f"(utils/elastic.py guarantees this for its plans)")
             micro = jax.tree.map(
                 lambda x: mesh_lib.constrain(
                     x.reshape(G, x.shape[0] // G, *x.shape[1:]),

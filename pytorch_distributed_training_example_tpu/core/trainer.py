@@ -36,6 +36,7 @@ from pytorch_distributed_training_example_tpu.data import (
 from pytorch_distributed_training_example_tpu.models import registry
 from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
 from pytorch_distributed_training_example_tpu.utils import chaos as chaos_lib
+from pytorch_distributed_training_example_tpu.utils import elastic as elastic_lib
 from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
 from pytorch_distributed_training_example_tpu.utils import resilience
 from pytorch_distributed_training_example_tpu.utils import telemetry as telemetry_lib
@@ -67,7 +68,8 @@ class Trainer:
             self.telemetry = telemetry_lib.Telemetry(
                 tdir, run_id=self.metric_logger.run_id,
                 anomaly_action=cfg.anomaly_action, config=cfg,
-                allow_scaler_skips=(cfg.precision == "fp16"))
+                allow_scaler_skips=(cfg.precision == "fp16"),
+                resume=bool(cfg.resume))
             log.info("telemetry on: health pack in metrics, spans/goodput/"
                      "anomaly bundles -> %s", tdir)
 
@@ -97,7 +99,17 @@ class Trainer:
                 else contextlib.nullcontext())
 
     def _init_workload(self, cfg: Config, mesh=None):
-        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(cfg.mesh_config())
+        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
+            cfg.mesh_config(), elastic=cfg.elastic)
+        # Elastic resume: BEFORE anything batch-dependent is built, peek the
+        # newest committed manifest for the geometry that wrote it; if the
+        # world size changed, rescale this run's batch geometry under the
+        # configured policy (utils/elastic.py) so the restore continues
+        # sample-exact at the surviving device count.
+        self._elastic_plan = None
+        if cfg.elastic and cfg.resume:
+            cfg = self._plan_elastic(cfg)
+            self.cfg = cfg
         self.policy = precision_lib.get_policy(cfg.precision)
 
         self.bundle = registry.create_model(
@@ -171,6 +183,11 @@ class Trainer:
 
         # optimizer / state ------------------------------------------------
         self.tx, self.schedule = optim.build_optimizer(cfg, self.steps_per_epoch)
+        # Warm the schedule's op-by-op dispatch here, inside the init span:
+        # the first eager evaluation costs ~0.2s of tracing that would
+        # otherwise land UNATTRIBUTED between the first step's spans and
+        # drag goodput coverage below its gate.
+        float(self.schedule(0))
         scaler = (precision_lib.ScalerState.create()
                   if precision_lib.needs_loss_scaling(self.policy) else None)
         model = self.bundle.module
@@ -261,6 +278,51 @@ class Trainer:
 
     # -- checkpoint glue ---------------------------------------------------
 
+    def _plan_elastic(self, cfg: Config) -> Config:
+        """Rescale the batch geometry when resuming at a changed world size.
+
+        Reads the newest committed manifest (JSON only — no array I/O, runs
+        before the model exists) and compares the recorded data-parallel
+        degree against this run's mesh. All the policy math lives in
+        ``utils/elastic.py``; this method just threads it into the config.
+        The relaunch command always carries the ORIGINAL launch geometry
+        (same argv + ``--resume auto``), so caps like ``--steps-per-epoch``
+        are remapped from the launched batch size, while the plan itself
+        starts from the RECORDED geometry so repeated shrinks compose.
+        """
+        root = cfg.checkpoint_dir
+        if cfg.resume not in ("auto", None):
+            root, _ = checkpoint_lib.split_resume_path(cfg.resume)
+        manifest = checkpoint_lib.peek_manifest(root) if root else None
+        if not manifest:
+            return cfg
+        recorded = dict(manifest.get("extra") or {})
+        geom = manifest.get("geometry") or {}
+        if "mesh_shape" not in recorded and geom.get("mesh_shape"):
+            recorded["mesh_shape"] = geom["mesh_shape"]
+        new_dp = mesh_lib.dp_size(self.mesh)
+        if elastic_lib.recorded_world(recorded) is None:
+            log.warning(
+                "elastic resume: checkpoint records no source geometry "
+                "(pre-elastic save) — resuming without batch rescale")
+            return cfg
+        plan = elastic_lib.plan_from_record(
+            recorded, policy=cfg.elastic_policy, new_world=new_dp,
+            fallback_global_batch=cfg.global_batch_size,
+            fallback_grad_accum=cfg.grad_accum_steps)
+        if plan is None:
+            return cfg  # world size unchanged
+        updates = {"global_batch_size": plan.global_batch_size,
+                   "grad_accum_steps": plan.grad_accum_steps,
+                   "lr": float(recorded.get("lr", cfg.lr)) * plan.lr_scale}
+        if cfg.steps_per_epoch and plan.global_batch_size != cfg.global_batch_size:
+            updates["steps_per_epoch"] = elastic_lib.remap_step_count(
+                cfg.steps_per_epoch, cfg.global_batch_size,
+                plan.global_batch_size)
+        self._elastic_plan = plan
+        log.warning("%s", plan.describe())
+        return cfg.replace(**updates)
+
     def _resume(self):
         """``--resume`` accepts 'auto', a checkpoint root, or a step_NNN dir."""
         step = None
@@ -287,28 +349,58 @@ class Trainer:
         # already applied, and the sampler — a pure function of
         # (seed, epoch) — regenerates the identical permutation, so
         # fast-forwarding the index stream is sample-exact.
-        offset = int(extra.get("step_offset", self.steps_per_epoch))
+        raw_offset = extra.get("step_offset")
+        offset = (self.steps_per_epoch if raw_offset is None
+                  else int(raw_offset))
+        if raw_offset is not None and self._elastic_plan is not None:
+            # Elastic resume: the recorded offset counts optimizer steps of
+            # the SAVING geometry. Convert it through the sample position
+            # (offset * old_gb must be a whole number of new batches —
+            # remap_step_offset raises otherwise), so the loader continues
+            # at the exact next unconsumed sample.
+            rec_gb = int(extra.get("global_batch_size",
+                                   self.cfg.global_batch_size))
+            if rec_gb != self.cfg.global_batch_size:
+                remapped = elastic_lib.remap_step_offset(
+                    offset, rec_gb, self.cfg.global_batch_size)
+                log.warning(
+                    "elastic resume: mid-epoch offset %d (gb %d) -> %d "
+                    "(gb %d); sample position %d preserved", offset, rec_gb,
+                    remapped, self.cfg.global_batch_size, offset * rec_gb)
+                offset = remapped
+            rec_spe = extra.get("steps_per_epoch")
+            if rec_spe is not None and (
+                    int(rec_spe) * rec_gb !=
+                    self.steps_per_epoch * self.cfg.global_batch_size):
+                log.warning(
+                    "elastic resume: epoch sample count changed (%d -> %d "
+                    "samples/epoch) — epoch boundaries shift at the dataset "
+                    "tail", int(rec_spe) * rec_gb,
+                    self.steps_per_epoch * self.cfg.global_batch_size)
         if offset < self.steps_per_epoch:
-            # Mid-epoch restore: the offset counts optimizer steps of the
-            # SAVING run's batch geometry. Resuming with a different
-            # --batch-size (or a loader that slices the epoch differently)
-            # would fast-forward to the wrong sample silently — refuse.
-            for key, current in (("global_batch_size",
-                                  self.cfg.global_batch_size),
-                                 ("steps_per_epoch", self.steps_per_epoch)):
-                recorded = extra.get(key)
-                if recorded is None:
-                    log.warning(
-                        "checkpoint predates %s recording; cannot verify "
-                        "the mid-epoch offset matches this run's batch "
-                        "geometry", key)
-                elif int(recorded) != current:
-                    raise ValueError(
-                        f"mid-epoch resume with mismatched {key}: checkpoint "
-                        f"was saved with {int(recorded)}, this run uses "
-                        f"{current}. The step offset {offset} would land on "
-                        "the wrong sample; resume with the original batch "
-                        "geometry or restart from an epoch boundary.")
+            if self._elastic_plan is None:
+                # Mid-epoch restore: the offset counts optimizer steps of the
+                # SAVING run's batch geometry. Resuming with a different
+                # --batch-size (or a loader that slices the epoch differently)
+                # would fast-forward to the wrong sample silently — refuse
+                # (pass --elastic to convert the offset instead).
+                for key, current in (("global_batch_size",
+                                      self.cfg.global_batch_size),
+                                     ("steps_per_epoch", self.steps_per_epoch)):
+                    recorded = extra.get(key)
+                    if recorded is None:
+                        log.warning(
+                            "checkpoint predates %s recording; cannot verify "
+                            "the mid-epoch offset matches this run's batch "
+                            "geometry", key)
+                    elif int(recorded) != current:
+                        raise ValueError(
+                            f"mid-epoch resume with mismatched {key}: checkpoint "
+                            f"was saved with {int(recorded)}, this run uses "
+                            f"{current}. The step offset {offset} would land on "
+                            "the wrong sample; resume with the original batch "
+                            "geometry, restart from an epoch boundary, or pass "
+                            "--elastic to rescale under a batch policy.")
             self.start_epoch = epoch
             self.start_step_offset = offset
             log.info("resumed from step %d (epoch %d, step offset %d)",
@@ -332,7 +424,15 @@ class Trainer:
         # the same way (_resume validates).
         extra = {"epoch": epoch,
                  "global_batch_size": self.cfg.global_batch_size,
-                 "steps_per_epoch": self.steps_per_epoch}
+                 "steps_per_epoch": self.steps_per_epoch,
+                 # Elastic-resume provenance (utils/elastic.py): the geometry
+                 # that produced this state, so a different-world relaunch can
+                 # rescale from what was actually running — repeated shrinks
+                 # compose, and scaled LR carries forward.
+                 "mesh_shape": {str(k): int(v)
+                                for k, v in dict(self.mesh.shape).items()},
+                 "grad_accum": self.cfg.grad_accum_steps,
+                 "lr": self.cfg.lr}
         if step_offset is not None:
             extra["step_offset"] = step_offset
         # One retry: save() first joins the previous background write, so a
@@ -355,6 +455,12 @@ class Trainer:
                 log.error("checkpoint save for step %d failed (%s) — "
                           "retrying once", step, e)
         self._last_saved_step = step
+        if self.telemetry is not None:
+            # Flush the goodput/timeline files alongside every durable save:
+            # an ABRUPT host loss (chaos kill_host, real hardware) writes no
+            # shutdown summary, so the restart-tax merge in the next attempt
+            # measures its gap from the last flush here.
+            self.telemetry.recorder.write(self.telemetry.directory)
 
     # -- resilience --------------------------------------------------------
 

@@ -67,3 +67,49 @@ class ShardedSampler:
 
     def __len__(self) -> int:
         return self.num_samples
+
+
+# ---------------------------------------------------------------------------
+# World-size invariance helpers (elastic resume, utils/elastic.py).
+#
+# With drop_last=True and any shard count W dividing the global batch G,
+# global batch b — the union over shards of each shard's batch b — is the
+# contiguous slice perm[b*G:(b+1)*G] of the epoch permutation *as a set*,
+# and the number of full global batches is floor(N/G) for every such W
+# (proof in utils/elastic.py's module docstring). These helpers materialize
+# the streams so the elastic remap can be asserted sample-exact.
+# ---------------------------------------------------------------------------
+
+
+def shard_batch_stream(num_examples: int, global_batch: int, num_shards: int,
+                       shard_id: int, *, seed: int = 0, epoch: int = 0,
+                       shuffle: bool = True) -> list[np.ndarray]:
+    """The exact per-batch index stream ``DataLoader`` yields for one shard:
+    the shard's strided slice, cut into per-shard batches, drop_last."""
+    if global_batch % num_shards:
+        raise ValueError(
+            f"global_batch {global_batch} not divisible by {num_shards} shards")
+    s = ShardedSampler(num_examples, num_shards, shard_id, shuffle=shuffle,
+                       seed=seed, drop_last=True)
+    s.set_epoch(epoch)
+    idx = s.local_indices()
+    per_shard = global_batch // num_shards
+    n_full = len(idx) // per_shard
+    return [idx[b * per_shard:(b + 1) * per_shard] for b in range(n_full)]
+
+
+def global_sample_stream(num_examples: int, global_batch: int,
+                         num_shards: int = 1, *, seed: int = 0,
+                         epoch: int = 0, shuffle: bool = True) -> np.ndarray:
+    """The epoch's flat consumed-sample stream: global batches concatenated
+    in step order, each batch's members in canonical (sorted) order so the
+    result is identical for every world size ``num_shards | global_batch``."""
+    streams = [shard_batch_stream(num_examples, global_batch, num_shards, r,
+                                  seed=seed, epoch=epoch, shuffle=shuffle)
+               for r in range(num_shards)]
+    n_batches = min(len(st) for st in streams)
+    if not n_batches:
+        return np.empty((0,), dtype=np.int64)
+    return np.concatenate([
+        np.sort(np.concatenate([st[b] for st in streams]))
+        for b in range(n_batches)])

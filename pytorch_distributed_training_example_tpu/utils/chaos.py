@@ -19,11 +19,20 @@ rather than trusted on inspection. ``--chaos`` takes a comma-separated spec:
     truncate_ckpt[@save=1] after the K-th save commits, truncate one array
                            file of the newest committed checkpoint (the CRC
                            fallback-restore drill; file choice is seeded)
+    kill_host@step=9       ABRUPT simulated host loss at the end of global
+                           step 9: record the victim host in
+                           ``dead_hosts.jsonl`` (utils/elastic.py), then
+                           ``os._exit(HOST_LOST_EXIT_CODE)`` — no emergency
+                           checkpoint, exactly like real hardware. An elastic
+                           supervisor relaunches one host smaller.
 
 Counters are GLOBAL (step/batch indices are ``epoch * steps_per_epoch + i``;
 save counts every ``Checkpointer.save`` call this process makes), and every
 event fires at most once per process — a run resumed past the trip point
 does not re-trip, which is what lets the supervisor restart converge.
+``kill_host`` additionally never re-fires once its victim is recorded dead
+(a dead host cannot die twice): a resumed attempt that re-runs the trip step
+— e.g. because the abrupt kill lost an uncommitted cadence save — skips it.
 
 Determinism: the spec + seed fully determine what fires where; the only
 randomness (truncation target choice) draws from a ``RandomState(seed)``.
@@ -42,7 +51,7 @@ import time
 
 import numpy as np
 
-from pytorch_distributed_training_example_tpu.utils import resilience
+from pytorch_distributed_training_example_tpu.utils import elastic, resilience
 
 log = logging.getLogger("pdtx")
 
@@ -56,6 +65,7 @@ _SITES = {
     "loader_stall": "batch",
     "ckpt_io_error": "save",
     "truncate_ckpt": "save",
+    "kill_host": "step",
 }
 
 
@@ -113,6 +123,7 @@ class ChaosEngine:
         self.events = parse_spec(spec)
         self.seed = seed
         self.rng = np.random.RandomState(seed)
+        self.log_dir = log_dir
         self.log_path = (os.path.join(log_dir, CHAOS_LOG)
                          if log_dir else None)
         # Set by the trainer so batch-site events can map (epoch, batch) to
@@ -120,6 +131,18 @@ class ChaosEngine:
         self.steps_per_epoch: int | None = None
         self._saves = 0
         self._io_faults_left = 0
+        # A host already recorded dead cannot die twice: pre-fire kill_host
+        # events whose drill already ran (the resumed attempt may re-run the
+        # trip step when the abrupt kill lost an uncommitted cadence save).
+        if log_dir:
+            dead = elastic.read_dead_hosts(log_dir)
+            kills = sorted((ev for ev in self.events
+                            if ev.name == "kill_host"), key=lambda e: e.value)
+            for ev in kills[:len(dead)]:  # one recorded death per past fire
+                ev.fired = True
+                log.info(
+                    "chaos: kill_host@step=%d disarmed — host(s) %s already "
+                    "recorded dead in %s", ev.value, sorted(dead), log_dir)
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -150,6 +173,36 @@ class ChaosEngine:
                 # A REAL signal through the real delivery path — the
                 # resilience handler, not a shortcut to its flag.
                 os.kill(os.getpid(), sig)
+        ev = self._take("kill_host", gstep)
+        if ev is not None:
+            self._kill_host(ev, gstep)
+
+    def _kill_host(self, ev: _Event, gstep: int) -> None:
+        """Abrupt simulated host loss: no emergency checkpoint, no cleanup —
+        the process is gone mid-whatever, exactly like real hardware. The
+        victim (deterministically the highest-index host) is recorded in the
+        dead-hosts file first, so the elastic supervisor knows to relaunch
+        one host smaller, and the chaos row is on disk for same-seed diffing.
+        """
+        host, world = 0, 1
+        try:  # lazy: the harness stays importable (and testable) without jax
+            import jax
+
+            world = (jax.process_count() if jax.process_count() > 1
+                     else jax.local_device_count())
+            host = world - 1
+        except Exception:  # pragma: no cover - no jax / uninitialized
+            pass
+        if self.log_dir:
+            elastic.record_dead_host(self.log_dir, host, world=world,
+                                     step=gstep, reason="chaos kill_host")
+        else:
+            log.warning("chaos: kill_host has no log_dir — the supervisor "
+                        "cannot learn the dead host; relaunch will be "
+                        "same-size")
+        self._record(ev, host=host, world=world,
+                     exit=resilience.HOST_LOST_EXIT_CODE)
+        os._exit(resilience.HOST_LOST_EXIT_CODE)
 
     def batch_hook(self, epoch: int, batch_idx: int, batch: dict) -> dict:
         """Loader yield-time hook (``data/loader.py`` ``set_batch_hook``)."""

@@ -80,6 +80,12 @@ class Config:
     # days-long epoch to a failure; BASELINE.json configs[4]).
     checkpoint_every_steps: int = 0
     resume: str | None = None  # path | "auto"
+    # elastic resume (utils/elastic.py): when resuming under a different
+    # world size, rebuild the mesh at the surviving size (degraded axes
+    # allowed) and rescale the batch geometry under elastic_policy instead
+    # of failing the mid-epoch geometry guard.
+    elastic: bool = False
+    elastic_policy: str = "keep_global_batch"  # | "scale_lr"
     evaluate: bool = False  # eval-only mode (main.py --evaluate)
     seed: int = 0
     # telemetry (utils/telemetry.py): on-device health pack in the metrics

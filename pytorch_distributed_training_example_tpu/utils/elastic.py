@@ -1,0 +1,233 @@
+"""Elastic resume: batch-rescale policies + index-stream remap (pure functions).
+
+When the supervisor (``launch.py --elastic``) relaunches a shrunk/grown gang,
+the training geometry changes: the data-parallel world size W goes from
+``old_world`` to ``new_world`` while the checkpoint on disk was written under
+the old geometry. Everything needed to continue *sample-exact* reduces to two
+pure decisions, both implemented here with no jax dependency so they are
+unit-testable and usable from the (jax-free) supervisor:
+
+1. **Batch policy** (:func:`rescale`) — what happens to the global batch:
+
+   ========================  ==============  ===================  ==========
+   policy                    global batch    grad accumulation    learning
+                                                                  rate
+   ========================  ==============  ===================  ==========
+   ``keep_global_batch``     unchanged       scaled by W_old/W_new unchanged
+   ``scale_lr``              scaled by        unchanged            scaled by
+                             W_new/W_old                          W_new/W_old
+   ========================  ==============  ===================  ==========
+
+   ``keep_global_batch`` preserves the optimization trajectory exactly: the
+   same samples enter the same optimizer updates in the same order (the
+   per-device microbatch stays constant; a shrink just replays more
+   microbatches through ``lax.scan``), so the loss curve matches a
+   fixed-topology run step-for-step and the LR schedule needs no adjustment.
+   ``scale_lr`` is classic linear scaling (Goyal et al.): smaller world →
+   smaller global batch → proportionally smaller LR. The *flat sample stream*
+   is still exactly the uninterrupted one (see invariance note below), but
+   optimizer-update boundaries move, so the loss curve is only statistically
+   — not bitwise — comparable, and the schedule continues on the
+   optimizer-step axis.
+
+2. **Index-stream remap** (:func:`remap_step_offset`) — where to continue in
+   the epoch's sample stream. A mid-epoch checkpoint records ``step_offset``
+   in *old* steps; the sample position is ``step_offset * old_global_batch``
+   and the resumed loader starts at batch ``samples // new_global_batch``.
+
+**Why the sampler is world-size invariant** (the property that makes all of
+this sample-exact): :class:`~...data.sampler.ShardedSampler` deals rank ``r``
+of ``W`` the strided slice ``perm[r::W]`` of one seed-deterministic global
+permutation, with ``drop_last`` truncating to a multiple of W. Global batch
+``b`` — the union over ranks of each rank's batch ``b`` — is therefore the
+*contiguous* slice ``perm[b*G : (b+1)*G]`` as a set, for any W dividing the
+global batch G. Steps per epoch are identical too: ``floor(floor(N/W) /
+(G/W)) == floor(N/G)`` for every W | G (if some multiple ``q*G`` landed in
+``(N - N%W, N]`` then ``N = q*G + s`` with ``s < N%W < W``, but ``N%W == s``
+— contradiction). So no sample is dropped or double-consumed across a world-
+size change; :func:`~...data.sampler.global_sample_stream` materializes the
+stream for tests and drills.
+
+The dead-host protocol (``dead_hosts.jsonl``) is how an abrupt host loss
+(chaos ``kill_host``, or a real hard failure detected by a health probe)
+tells the supervisor to shrink: the dying attempt appends one JSON line into
+the checkpoint dir; the supervisor reads the unique host ids and relaunches
+with that many fewer hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+KEEP_GLOBAL_BATCH = "keep_global_batch"
+SCALE_LR = "scale_lr"
+POLICIES = (KEEP_GLOBAL_BATCH, SCALE_LR)
+
+#: One JSON line per lost host, appended into the checkpoint/log dir by the
+#: dying attempt and read by the supervisor before relaunch.
+DEAD_HOSTS_FILE = "dead_hosts.jsonl"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPlan:
+    """Result of :func:`rescale` — the new geometry, plus provenance."""
+
+    policy: str
+    old_world: int
+    new_world: int
+    global_batch_size: int
+    grad_accum_steps: int
+    lr_scale: float
+    note: str
+
+    def describe(self) -> str:
+        return (f"elastic [{self.policy}]: world {self.old_world} -> "
+                f"{self.new_world}, global_batch={self.global_batch_size}, "
+                f"grad_accum={self.grad_accum_steps}, "
+                f"lr_scale={self.lr_scale:g} ({self.note})")
+
+
+def rescale(policy: str, *, old_world: int, new_world: int,
+            global_batch: int, grad_accum: int = 1) -> BatchPlan:
+    """Pure batch-geometry policy: old world -> new world.
+
+    ``old_world``/``new_world`` are data-parallel degrees (``mesh data*fsdp``
+    in this repo). ``global_batch``/``grad_accum`` are the values *recorded at
+    save time* — rescaling always starts from the geometry that produced the
+    checkpoint, so repeated shrinks compose correctly.
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown elastic policy {policy!r}; one of {POLICIES}")
+    if old_world < 1 or new_world < 1:
+        raise ValueError(f"world sizes must be >= 1, got {old_world} -> {new_world}")
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if global_batch % (old_world * grad_accum):
+        raise ValueError(
+            f"global_batch {global_batch} not divisible by old world "
+            f"{old_world} x grad_accum {grad_accum}")
+
+    if policy == KEEP_GLOBAL_BATCH:
+        # Keep the per-device microbatch constant: the total microbatch count
+        # per update is grad_accum * old_world; redistribute it over the new
+        # world. When the redistribution isn't integral (e.g. 3 -> 2 hosts),
+        # round accumulation UP to the next value that divides the per-host
+        # batch — a slightly smaller microbatch, never a larger one.
+        scaled = grad_accum * old_world
+        accum, rem = divmod(scaled, new_world)
+        if rem:
+            accum += 1
+        while global_batch % (new_world * accum):
+            accum += 1
+        note = ("per-device microbatch preserved" if not rem else
+                "accumulation rounded up (non-integral world ratio)")
+        return BatchPlan(policy, old_world, new_world, global_batch,
+                         max(1, accum), 1.0, note)
+
+    # SCALE_LR: linear scaling rule.
+    scaled_gb, rem = divmod(global_batch * new_world, old_world)
+    if rem or scaled_gb % (new_world * grad_accum):
+        raise ValueError(
+            f"scale_lr cannot produce an integral global batch: "
+            f"{global_batch} * {new_world}/{old_world} with grad_accum "
+            f"{grad_accum}")
+    return BatchPlan(policy, old_world, new_world, scaled_gb, grad_accum,
+                     new_world / old_world,
+                     "linear LR scaling, per-device batch preserved")
+
+
+def remap_step_offset(step_offset: int, old_global_batch: int,
+                      new_global_batch: int) -> int:
+    """Convert a mid-epoch step offset across a global-batch change.
+
+    The invariant is the *sample* position: ``step_offset`` old-geometry
+    steps consumed ``step_offset * old_global_batch`` samples of the epoch's
+    flat stream; the resumed run continues at the batch covering the next
+    sample. Non-divisible positions are rejected rather than silently
+    replaying or skipping a partial batch — with both policies' integral
+    constraints this cannot happen for offsets the trainer actually records.
+    """
+    samples = step_offset * old_global_batch
+    offset, rem = divmod(samples, new_global_batch)
+    if rem:
+        raise ValueError(
+            f"sample position {samples} (offset {step_offset} x gb "
+            f"{old_global_batch}) is not a whole number of new batches "
+            f"(gb {new_global_batch}) — cannot resume sample-exact")
+    return offset
+
+
+def remap_step_count(steps: int, old_global_batch: int,
+                     new_global_batch: int) -> int:
+    """Same sample-position math for step *counts* (``--steps-per-epoch``
+    caps, cumulative step budgets)."""
+    return remap_step_offset(steps, old_global_batch, new_global_batch)
+
+
+def plan_from_record(recorded: dict, *, policy: str, new_world: int,
+                     fallback_global_batch: int,
+                     fallback_grad_accum: int = 1) -> BatchPlan | None:
+    """Build a :class:`BatchPlan` from a checkpoint's recorded geometry.
+
+    ``recorded`` is the manifest ``extra`` dict. Returns None when the
+    checkpoint predates geometry recording (nothing to rescale against) or
+    when the world size is unchanged.
+    """
+    old_world = recorded_world(recorded)
+    if old_world is None or old_world == new_world:
+        return None
+    return rescale(
+        policy, old_world=old_world, new_world=new_world,
+        global_batch=int(recorded.get("global_batch_size",
+                                      fallback_global_batch)),
+        grad_accum=int(recorded.get("grad_accum", fallback_grad_accum)))
+
+
+def recorded_world(recorded: dict) -> int | None:
+    """Data-parallel degree recorded at save time (``mesh_shape`` data*fsdp,
+    falling back to an explicit ``world`` field)."""
+    mesh_shape = recorded.get("mesh_shape")
+    if isinstance(mesh_shape, dict) and mesh_shape:
+        return int(mesh_shape.get("data", 1)) * int(mesh_shape.get("fsdp", 1))
+    world = recorded.get("world")
+    return int(world) if world is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Dead-host protocol (jax-free; shared by chaos harness and supervisor).
+# ---------------------------------------------------------------------------
+
+
+def record_dead_host(directory: str, host: int, *, world: int | None = None,
+                     step: int | None = None, reason: str = "") -> str:
+    """Append one dead-host record; returns the file path. Append-only and
+    line-atomic (one ``write`` call) so a dying process can't corrupt it."""
+    path = os.path.join(directory, DEAD_HOSTS_FILE)
+    row = {"host": int(host), "world": world, "step": step, "reason": reason}
+    with open(path, "a") as fh:
+        fh.write(json.dumps(row) + "\n")
+    return path
+
+
+def read_dead_hosts(directory: str) -> set[int]:
+    """Unique host ids recorded dead under ``directory`` (empty if no file).
+    Unparseable lines (a host died mid-``write`` despite line-atomicity,
+    filesystem truncation) are skipped — a lost record degrades to a
+    same-size relaunch, never a crash."""
+    path = os.path.join(directory, DEAD_HOSTS_FILE)
+    hosts: set[int] = set()
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    hosts.add(int(json.loads(line)["host"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+    except FileNotFoundError:
+        pass
+    return hosts

@@ -41,6 +41,13 @@ log = logging.getLogger("pdtx")
 #: distinct from the fault injector's hard-kill (57) and ordinary crashes.
 PREEMPTED_EXIT_CODE = 75
 
+#: Exit code of an *abrupt* simulated host loss (chaos ``kill_host``): the
+#: process dies without an emergency checkpoint, exactly like real hardware.
+#: An elastic supervisor (``launch.py --elastic``) treats it as restartable
+#: — at a smaller world size, per the dead-host records (``utils/elastic``);
+#: a fixed-gang supervisor only restarts it under ``on-failure``.
+HOST_LOST_EXIT_CODE = 76
+
 _flag = threading.Event()
 _signum: int | None = None
 _prev_handlers: dict[int, object] = {}

@@ -45,8 +45,11 @@ log = logging.getLogger("pdtx")
 PRODUCTIVE_SPANS = ("step",)
 
 #: Badput categories the trainer emits (order is the report order).
+#: "restart" is synthesized, not timed by a span: the wall-clock gap between
+#: a previous supervisor attempt's last goodput write and this attempt's
+#: start (the restart tax of an elastic/preemption relaunch).
 BADPUT_SPANS = ("init", "compile", "input_wait", "checkpoint_save",
-                "checkpoint_restore", "eval", "anomaly_dump")
+                "checkpoint_restore", "eval", "anomaly_dump", "restart")
 
 
 class AnomalyError(RuntimeError):
@@ -134,7 +137,7 @@ class SpanRecorder:
     captured by ``--profile-steps``.
     """
 
-    def __init__(self, run_id: str = ""):
+    def __init__(self, run_id: str = "", carry: dict | None = None):
         self.run_id = run_id
         self._start = time.perf_counter()
         self._events: list[dict] = []
@@ -142,6 +145,36 @@ class SpanRecorder:
         self._counts: collections.defaultdict = collections.defaultdict(int)
         self._depth = 0
         self._pid = jax.process_index()
+        # Cross-attempt carryover (elastic/preemption relaunch): ``carry`` is
+        # a previous attempt's goodput.json dict. Its categories/counts/wall
+        # seed the cumulative totals, and the gap between its ``ended_at``
+        # and now becomes one "restart" badput interval — so the merged
+        # goodput.json decomposes the FULL job wall-clock, restart tax
+        # included, not just the current attempt.
+        self._base_totals: dict[str, float] = {}
+        self._base_counts: dict[str, int] = {}
+        self._base_wall = 0.0
+        self.attempts = 1
+        if carry:
+            self._base_totals = {k: float(v) for k, v in
+                                 (carry.get("categories_s") or {}).items()}
+            self._base_counts = {k: int(v) for k, v in
+                                 (carry.get("counts") or {}).items()}
+            self._base_wall = float(carry.get("wall_s") or 0.0)
+            self.attempts = int(carry.get("attempts") or 1) + 1
+            ended = carry.get("ended_at")
+            if ended is not None:
+                gap = max(0.0, time.time() - float(ended))
+                self._base_totals["restart"] = (
+                    self._base_totals.get("restart", 0.0) + gap)
+                self._base_counts["restart"] = (
+                    self._base_counts.get("restart", 0) + 1)
+                self._base_wall += gap
+                # Timeline marker: the gap sits BEFORE this attempt's origin.
+                self._events.append({
+                    "name": "restart", "ph": "X", "cat": "telemetry",
+                    "ts": -int(gap * 1e6), "dur": int(gap * 1e6),
+                    "pid": self._pid, "tid": 0})
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -175,27 +208,37 @@ class SpanRecorder:
                 "otherData": {"run_id": self.run_id}}
 
     def goodput(self) -> dict:
-        """Wall-clock decomposition since construction.
+        """Wall-clock decomposition since construction (plus carried attempts).
 
         ``goodput_fraction`` is the productive ("step") share; ``coverage``
         is the fraction of wall-clock any top-level span accounts for —
         the acceptance bar asks for >= 0.95, the rest is loop bookkeeping.
         Fractions sum to ``coverage`` <= 1 by construction (top-level spans
-        cannot overlap on one thread).
+        cannot overlap on one thread). With carried attempts the totals and
+        wall are CUMULATIVE over every attempt plus the restart gaps;
+        ``attempts``/``ended_at`` let the next attempt keep merging.
         """
-        wall = max(self.wall_s, 1e-9)
-        cats = {k: round(v, 4) for k, v in sorted(self._totals.items())}
-        fracs = {k: v / wall for k, v in self._totals.items()}
+        wall = max(self._base_wall + self.wall_s, 1e-9)
+        totals = dict(self._base_totals)
+        for k, v in self._totals.items():
+            totals[k] = totals.get(k, 0.0) + v
+        counts = dict(self._base_counts)
+        for k, v in self._counts.items():
+            counts[k] = counts.get(k, 0) + v
+        cats = {k: round(v, 4) for k, v in sorted(totals.items())}
+        fracs = {k: v / wall for k, v in totals.items()}
         good = sum(fracs.get(k, 0.0) for k in PRODUCTIVE_SPANS)
         return {
             "run_id": self.run_id,
             "wall_s": round(wall, 4),
             "categories_s": cats,
-            "counts": dict(self._counts),
+            "counts": counts,
             "fractions": {k: round(v, 4) for k, v in sorted(fracs.items())},
             "goodput_fraction": round(good, 4),
             "badput_fraction": round(sum(fracs.values()) - good, 4),
             "coverage": round(sum(fracs.values()), 4),
+            "attempts": self.attempts,
+            "ended_at": round(time.time(), 3),
         }
 
     def write(self, directory: str) -> None:
@@ -204,6 +247,15 @@ class SpanRecorder:
             json.dump(self.trace_events(), fh)
         with open(os.path.join(directory, "goodput.json"), "w") as fh:
             json.dump(self.goodput(), fh, indent=1)
+
+
+def load_goodput(directory: str) -> dict | None:
+    """Previous attempt's goodput summary (None if absent/unparseable)."""
+    try:
+        with open(os.path.join(directory, "goodput.json")) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -312,9 +364,22 @@ class Telemetry:
 
     def __init__(self, directory: str, run_id: str = "",
                  anomaly_action: str = "abort", config: Any = None,
-                 history_keep: int = 32, allow_scaler_skips: bool = False):
+                 history_keep: int = 32, allow_scaler_skips: bool = False,
+                 resume: bool = False):
         self.directory = directory
-        self.recorder = SpanRecorder(run_id=run_id)
+        # ``resume=True`` (a --resume run, e.g. a supervisor relaunch) merges
+        # a previous attempt's goodput.json into this one: cumulative
+        # categories plus a "restart" badput interval for the gap. The file
+        # in ``directory`` then always decomposes the whole job so far.
+        carry = load_goodput(directory) if resume else None
+        if carry and carry.get("run_id") == run_id:
+            carry = None  # same attempt rewriting its own file: nothing to merge
+        self.recorder = SpanRecorder(run_id=run_id, carry=carry)
+        if carry:
+            log.info(
+                "telemetry: merging goodput across supervisor attempts — "
+                "attempt %d, %.1fs of prior wall-clock carried",
+                self.recorder.attempts, carry.get("wall_s", 0.0))
         self.guard = AnomalyGuard(
             directory, action=anomaly_action, keep=history_keep,
             config=config, run_id=run_id, goodput_fn=self.recorder.goodput,
