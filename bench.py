@@ -109,7 +109,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           moe_capacity_factor: float = 1.25, moe_top_k: int = 2,
           moe_dispatch_impl: str = "gather", moe_combine_dtype: str = "fp32",
           moe_router_dtype: str = "fp32", moe_router_impl: str = "reference",
-          remat_policy: str = "nothing", telemetry: bool = False):
+          remat_policy: str = "nothing", telemetry: bool = False,
+          fleet_obs: bool = False):
     import jax
     import numpy as np
 
@@ -151,6 +152,51 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
         return {k: np.asarray(v) for k, v in out.items()} if telemetry \
             else np.asarray(out)
 
+    # Fleet-observability overhead mode (--fleet-obs): run the EXACT host-side
+    # per-step work the trainer adds for utils/fleetobs.py — flight-recorder
+    # ring append, buffered step-row write, straggler-monitor median check —
+    # inside the timed region, once per scanned step, with a live /metrics
+    # HTTP server scrape-able throughout. The step_ms delta vs a plain run is
+    # the measured fleet-layer tax (BASELINE.md; expected ~0: the ring is a
+    # deque append and the writer batches 32 rows per syscall).
+    fleet = None
+    if fleet_obs:
+        import tempfile
+
+        from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+        fdir = tempfile.mkdtemp(prefix="bench_fleetobs_")
+        fleet = {
+            "server": fleetobs.MetricsServer(port=0).start(),
+            "flight": fleetobs.FlightRecorder(256),
+            "monitor": fleetobs.StragglerMonitor(),
+            "writer": fleetobs.StepRowWriter(fdir, rank=0, attempt=1,
+                                             meta={"bench": model_name}),
+            "dir": fdir, "gstep": 0, "host_s": float("inf"),
+        }
+
+    def fleet_step_work(rep_s: float) -> float:
+        """The trainer's per-step fleetobs host work, repeated ``steps``
+        times (the scan ran that many device steps); returns seconds spent.
+        Per-rep (= the trainer's log cadence) it also refreshes the gauges
+        behind the live endpoint and the atomic progress.json."""
+        from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+        per_step = rep_s / steps
+        f0 = time.perf_counter()
+        for _ in range(steps):
+            g = fleet["gstep"]
+            fleet["gstep"] = g + 1
+            row = {"total_s": per_step, "input_wait_s": 0.0,
+                   "compute_s": per_step, "checkpoint_s": 0.0}
+            fleet["flight"].record_timing(g, **row)
+            fleet["writer"].add({"step": g, **row})
+            fleet["monitor"].observe(g, total_s=per_step, input_wait_s=0.0)
+        fleet["server"].update(step=fleet["gstep"], step_time_s=per_step)
+        fleetobs.write_progress(fleet["dir"],
+                               {"step": fleet["gstep"], "status": "bench"})
+        return time.perf_counter() - f0
+
     with mesh_lib.use_mesh(mesh):
         compiled = run_steps.lower(state, batch).compile()
         state, out = compiled(state, batch)  # warm (first run pays setup)
@@ -160,7 +206,17 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             t0 = time.perf_counter()
             state, out = compiled(state, batch)
             fetch(out)  # forces execution; per-step losses are real
+            if fleet is not None:
+                fleet["host_s"] = min(
+                    fleet["host_s"],
+                    fleet_step_work(time.perf_counter() - t0))
             dt = min(dt, time.perf_counter() - t0)
+    if fleet is not None:
+        import shutil
+
+        fleet["writer"].flush()
+        fleet["server"].stop()
+        shutil.rmtree(fleet["dir"], ignore_errors=True)
     try:
         ca = compiled.cost_analysis() or {}
         if isinstance(ca, list):  # XLA:CPU returns [dict], TPU a dict
@@ -228,6 +284,10 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
             "strategy": strategy,
             "attn_impl": attn_impl,
             **({"telemetry": True} if telemetry else {}),
+            **({"fleet_obs": True,
+                "fleetobs_host_us_per_step": round(
+                    fleet["host_s"] / steps * 1e6, 2)}
+               if fleet is not None else {}),
             **({"moe_dispatch_impl": moe_dispatch_impl,
                 "moe_top_k": moe_top_k,
                 "moe_combine_dtype": moe_combine_dtype,
@@ -451,6 +511,12 @@ def main(argv=None):
                    help="compile the on-device health pack into the step "
                         "(utils/telemetry.py) — measures its overhead vs "
                         "the default row")
+    p.add_argument("--fleet-obs", action="store_true", dest="fleet_obs",
+                   help="run the fleet-observability host work "
+                        "(utils/fleetobs.py flight recorder + step rows + "
+                        "straggler monitor + live /metrics endpoint) inside "
+                        "the timed loop — measures its overhead vs the "
+                        "default row")
     p.add_argument("--no-measured-roofline", action="store_true",
                    help="skip the xplane-measured roofline pass (resnet50 "
                         "headline only; ~2 min extra)")
@@ -474,7 +540,8 @@ def main(argv=None):
                    moe_combine_dtype=args.moe_combine,
                    moe_router_dtype=args.moe_router_dtype,
                    moe_router_impl=args.moe_router_impl,
-                   remat_policy=args.remat_policy, telemetry=args.telemetry)
+                   remat_policy=args.remat_policy, telemetry=args.telemetry,
+                   fleet_obs=args.fleet_obs)
     if (args.model == "resnet50" and not args.no_measured_roofline):
         # Measured-bytes roofline (VERDICT r3 #3): per-executed-op buffer
         # traffic from the scheduled HLO joined with xplane durations —

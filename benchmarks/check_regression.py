@@ -121,6 +121,18 @@ def check_goodput(path: str, min_coverage: float = 0.95):
         return failures, report
     attempts = int(data.get("attempts", 1))
     restart_s = float(data.get("categories_s", {}).get("restart", 0.0))
+    # Mixed-run refusal: a cumulative/fleet summary stamped with more than
+    # one run id silently sums UNRELATED attempts (stale artifacts in a
+    # reused checkpoint dir) — its coverage and goodput are meaningless, so
+    # fail loudly instead of gating on fiction.
+    run_ids = [r for r in (data.get("run_ids") or []) if r]
+    if len(set(run_ids)) > 1:
+        msg = (f"goodput {path}: merged across {len(set(run_ids))} different "
+               f"runs {sorted(set(run_ids))} — refusing to gate a mixed-run "
+               f"summary (stale artifacts? clear the dir or re-merge)")
+        failures.append(msg)
+        report.append("MIXED-RUN " + msg)
+        return failures, report
     line = (f"goodput {path}: coverage {coverage:.3f} over {wall:.1f}s wall, "
             f"{attempts} attempt(s), restart tax {restart_s:.1f}s")
     if coverage < min_coverage:

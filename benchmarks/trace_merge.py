@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Merge per-rank, per-attempt telemetry into one fleet view.
+
+Inputs (all under one checkpoint/log directory, written by
+``utils/telemetry.py``):
+
+- ``trace_events.r<R>.a<A>.json`` (+ legacy plain ``trace_events.json``)
+- ``goodput.r<R>.a<A>.json``      (+ legacy plain ``goodput.json``)
+- ``steprows.r<R>.a<A>.jsonl``    (per-step host timings, log-cadence flushed)
+
+Outputs:
+
+- ``merged_trace.json``  — one clock-aligned Perfetto/Chrome trace: each
+  (host, rank) becomes a process track group (named via ``process_name``
+  metadata events), attempts stack on the shared wall clock, and restart
+  badput gaps appear as explicit ``restart`` slices.
+- ``fleet_goodput.json`` — per-rank cumulative goodput folded into one fleet
+  summary (``utils/fleetobs.aggregate_goodput``).
+- ``straggler.jsonl``    — per-step skew attribution across ranks
+  (``utils/fleetobs.detect_stragglers``).
+
+Clock alignment: every trace stamps a monotonic<->wall anchor captured at
+recorder construction. Event ``ts`` values are microseconds after that
+host's monotonic origin; shifting each file by ``(wall_origin -
+min(wall_origins)) * 1e6`` puts all ranks and attempts on one axis whose
+zero is the earliest attempt's start. Host clocks are NTP-close (ms), which
+is plenty for second-scale spans.
+
+Torn files: a host killed mid-write (chaos ``kill_host``, real hardware
+loss) leaves a truncated JSON. Because the writer puts ``otherData`` FIRST,
+the salvage walks back from the end of the buffer trying successively
+shorter prefixes closed with ``]}`` — recovering the header and every
+complete event, exactly the spirit of ``utils/elastic.read_dead_hosts``.
+
+Exits non-zero (loudly) when artifacts from DIFFERENT runs are mixed in one
+directory, unless ``--allow-mixed-run`` is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_training_example_tpu.utils import fleetobs  # noqa: E402
+
+MERGED_TRACE = "merged_trace.json"
+FLEET_GOODPUT = "fleet_goodput.json"
+
+_TRACE_RE = re.compile(r"trace_events\.r(\d+)\.a(\d+)\.json$")
+_GOODPUT_RE = re.compile(r"goodput\.r(\d+)\.a(\d+)\.json$")
+
+
+def load_trace_salvage(path: str) -> dict | None:
+    """Parse a (possibly torn) trace file; None when nothing is salvageable.
+
+    Fast path: plain ``json.load``. Torn path: try successively shorter
+    prefixes ending at a ``}`` (an event boundary), closing the events array
+    and the root object — keeps the header and all complete events.
+    """
+    try:
+        with open(path) as fh:
+            raw = fh.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(raw)
+        return doc if isinstance(doc, dict) else None
+    except ValueError:
+        pass
+    end = len(raw)
+    for _ in range(4096):  # bounded: one step back per damaged event
+        cut = raw.rfind("}", 0, end)
+        if cut < 0:
+            return None
+        for closer in ("]}", "}"):  # torn inside events vs inside header
+            try:
+                doc = json.loads(raw[:cut + 1] + closer)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                doc["_salvaged"] = True
+                return doc
+        end = cut
+    return None
+
+
+def discover(directory: str) -> dict[tuple[int, int], str]:
+    """(rank, attempt) -> trace path. Suffixed files win; the legacy plain
+    file fills in rank 0 only when no suffixed rank-0 file exists."""
+    found: dict[tuple[int, int], str] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return {}
+    for name in names:
+        m = _TRACE_RE.fullmatch(name)
+        if m:
+            found[(int(m.group(1)), int(m.group(2)))] = os.path.join(
+                directory, name)
+    if not any(r == 0 for r, _ in found):
+        plain = os.path.join(directory, "trace_events.json")
+        if os.path.exists(plain):
+            found[(0, 1)] = plain
+    return found
+
+
+def _anchor_wall(doc: dict) -> float | None:
+    anchor = (doc.get("otherData") or {}).get("clock_anchor") or {}
+    try:
+        return float(anchor["wall"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def merge_traces(directory: str, *, allow_mixed_run: bool = False) -> dict:
+    """Build the merged, clock-aligned trace dict (see module docstring)."""
+    paths = discover(directory)
+    docs: dict[tuple[int, int], dict] = {}
+    for key in sorted(paths):
+        doc = load_trace_salvage(paths[key])
+        if doc is None:
+            print(f"trace_merge: {paths[key]} unsalvageable — skipped",
+                  file=sys.stderr)
+            continue
+        docs[key] = doc
+    if not docs:
+        raise SystemExit(f"trace_merge: no readable trace files in "
+                         f"{directory!r}")
+
+    run_ids = sorted({(d.get("otherData") or {}).get("run_id") or "<unstamped>"
+                      for d in docs.values()})
+    if len(run_ids) > 1 and not allow_mixed_run:
+        raise SystemExit(
+            f"trace_merge: refusing to merge artifacts from {len(run_ids)} "
+            f"different runs {run_ids} in {directory!r} — stale files from a "
+            f"previous experiment? (--allow-mixed-run to override)")
+
+    # Wall anchors: earliest one is the merged time origin. Unanchored
+    # (legacy) docs sit at offset 0 — their spans still render, unaligned.
+    walls = [w for w in (_anchor_wall(d) for d in docs.values())
+             if w is not None]
+    origin = min(walls) if walls else 0.0
+
+    events: list[dict] = []
+    pid_by_group: dict[tuple[str, int], int] = {}
+    for (rank, attempt), doc in sorted(docs.items()):
+        other = doc.get("otherData") or {}
+        host = other.get("host") or "host"
+        group = (host, int(other.get("rank", rank)))
+        if group not in pid_by_group:
+            pid = len(pid_by_group) + 1
+            pid_by_group[group] = pid
+            events.append({  # Perfetto track-group label
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"{group[0]}/rank{group[1]}"}})
+        pid = pid_by_group[group]
+        wall = _anchor_wall(doc)
+        shift_us = int(((wall - origin) if wall is not None else 0.0) * 1e6)
+        for ev in doc.get("traceEvents") or []:
+            if not isinstance(ev, dict) or "ts" not in ev:
+                continue
+            out = dict(ev)
+            out["ts"] = int(ev["ts"]) + shift_us
+            out["pid"] = pid
+            if attempt > 1:
+                out.setdefault("args", {})
+                out["args"] = {**out["args"], "attempt": attempt}
+            events.append(out)
+
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("pid", 0),
+                               e.get("ts", 0)))
+    return {
+        "otherData": {
+            "schema_version": fleetobs.SCHEMA_VERSION,
+            "run_ids": run_ids,
+            "merged_from": {f"r{r}.a{a}": os.path.basename(paths[(r, a)])
+                            for (r, a) in sorted(docs)},
+            "track_groups": {f"{h}/rank{r}": pid
+                             for (h, r), pid in pid_by_group.items()},
+            "salvaged": sorted(
+                f"r{r}.a{a}" for (r, a), d in docs.items()
+                if d.get("_salvaged")),
+            "origin_wall": origin,
+        },
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+    }
+
+
+def collect_goodput(directory: str) -> dict[int, dict]:
+    """Final (highest-attempt) cumulative goodput per rank."""
+    best: dict[int, tuple[int, str]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in names:
+        m = _GOODPUT_RE.fullmatch(name)
+        if m:
+            rank, attempt = int(m.group(1)), int(m.group(2))
+            if rank not in best or attempt > best[rank][0]:
+                best[rank] = (attempt, os.path.join(directory, name))
+    out: dict[int, dict] = {}
+    for rank, (_, path) in sorted(best.items()):
+        try:
+            with open(path) as fh:
+                out[rank] = json.load(fh)
+        except (OSError, ValueError):
+            print(f"trace_merge: unreadable {path} — skipped",
+                  file=sys.stderr)
+    if 0 not in out:  # legacy plain file covers rank 0
+        plain = os.path.join(directory, "goodput.json")
+        try:
+            with open(plain) as fh:
+                out[0] = json.load(fh)
+        except (OSError, ValueError):
+            pass
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank telemetry into one fleet trace/goodput")
+    ap.add_argument("directory", help="checkpoint/log dir with the artifacts")
+    ap.add_argument("--out-dir", default=None,
+                    help="where to write outputs (default: the input dir)")
+    ap.add_argument("--straggler-threshold", type=float, default=2.0,
+                    help="flag steps slower than this multiple of the "
+                         "fleet-typical step time (default 2.0)")
+    ap.add_argument("--allow-mixed-run", action="store_true",
+                    help="merge artifacts even when run ids differ")
+    args = ap.parse_args(argv)
+    out_dir = args.out_dir or args.directory
+    os.makedirs(out_dir, exist_ok=True)
+
+    merged = merge_traces(args.directory,
+                          allow_mixed_run=args.allow_mixed_run)
+    trace_path = os.path.join(out_dir, MERGED_TRACE)
+    with open(trace_path, "w") as fh:
+        json.dump(merged, fh)
+    groups = merged["otherData"]["track_groups"]
+    salvaged = merged["otherData"]["salvaged"]
+    print(f"trace_merge: {trace_path} — {len(merged['traceEvents'])} events, "
+          f"{len(groups)} track group(s)"
+          + (f", salvaged {salvaged}" if salvaged else ""))
+
+    per_rank = collect_goodput(args.directory)
+    if per_rank:
+        fleet = fleetobs.aggregate_goodput(per_rank)
+        if len(fleet.get("run_ids") or []) > 1 and not args.allow_mixed_run:
+            raise SystemExit(
+                f"trace_merge: goodput artifacts span runs "
+                f"{fleet['run_ids']} — refusing (--allow-mixed-run to "
+                f"override)")
+        gp_path = os.path.join(out_dir, FLEET_GOODPUT)
+        fleetobs.write_json_atomic(gp_path, fleet)
+        print(f"trace_merge: {gp_path} — ranks {fleet['ranks']}, "
+              f"goodput {fleet['goodput_fraction']:.1%}, "
+              f"coverage {fleet['coverage']:.1%}, "
+              f"attempts {fleet['attempts']}")
+
+    rows_by_rank = fleetobs.load_steprows(args.directory)
+    if rows_by_rank:
+        rows = fleetobs.detect_stragglers(
+            rows_by_rank, threshold=args.straggler_threshold)
+        sg_path = fleetobs.write_stragglers(out_dir, rows)
+        flagged = [r for r in rows if r["flagged"]]
+        print(f"trace_merge: {sg_path} — {len(rows)} step(s) compared, "
+              f"{len(flagged)} flagged"
+              + (f" (worst: step {max(flagged, key=lambda r: r['delta_s'])['step']}"
+                 f" rank {max(flagged, key=lambda r: r['delta_s'])['slowest_rank']}"
+                 f" +{max(flagged, key=lambda r: r['delta_s'])['delta_s']:.3f}s)"
+                 if flagged else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -207,6 +207,12 @@ def main(argv=None):
                         "the full gang; give up below MIN hosts. Makes the "
                         f"abrupt host-loss exit ({HOST_LOST_EXIT_CODE}) "
                         "restartable under any restart policy")
+    p.add_argument("--trace-merge", default="auto", choices=["auto", "off"],
+                   help="after the gang exits (any code), merge per-rank "
+                        "telemetry in the child's --checkpoint-dir into one "
+                        "fleet trace/goodput/straggler report "
+                        "(benchmarks/trace_merge.py); auto = when artifacts "
+                        "exist")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- script.py args...")
     args = p.parse_args(argv)
@@ -223,7 +229,45 @@ def main(argv=None):
         except ValueError as e:
             p.error(str(e))
     os.makedirs(args.log_dir, exist_ok=True)
+    code = supervise(args, cmd, elastic)
+    if args.trace_merge == "auto":
+        # Post-mortem-friendly: the merge runs after EVERY terminal outcome
+        # — success, budget exhaustion, elastic give-up — because the fleet
+        # view matters most when the run died. Best-effort by design.
+        merge_traces(cmd)
+    return code
 
+
+def merge_traces(cmd: list[str]) -> None:
+    """Merge the attempt's telemetry artifacts into the fleet view (one
+    subprocess call of ``benchmarks/trace_merge.py``; skipped quietly when
+    there is nothing to merge or the script is absent)."""
+    ckdir = find_flag(cmd, "--checkpoint-dir")
+    if not ckdir or not os.path.isdir(ckdir):
+        return
+    try:
+        names = os.listdir(ckdir)
+    except OSError:
+        return
+    if not any(n.startswith("trace_events") and n.endswith(".json")
+               for n in names):
+        return
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "trace_merge.py")
+    if not os.path.exists(script):
+        return
+    try:
+        res = subprocess.run([sys.executable, script, ckdir],
+                             capture_output=True, text=True, timeout=120)
+        out = (res.stdout or res.stderr or "").strip()
+        tag = "" if res.returncode == 0 else f" (exit {res.returncode})"
+        print(f"launch.py: trace merge{tag}:\n{out}", file=sys.stderr)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"launch.py: trace merge failed ({e})", file=sys.stderr)
+
+
+def supervise(args, cmd, elastic) -> int:
+    """The restart loop: run the gang until a terminal exit code."""
     # The elastic "world" is whichever knob actually multiplexes hosts in
     # this launch: real processes when --nprocs > 1, else fake CPU devices
     # (the single-process local pod used by tests and dryrun drills).

@@ -147,7 +147,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault injection spec, e.g. "
                         "'sigterm@step=7,ckpt_io_error@save=2,"
                         "nan_grad@step=5,loader_stall@batch=3,"
-                        "truncate_ckpt@save=1' (utils/chaos.py)")
+                        "truncate_ckpt@save=1' (utils/chaos.py); "
+                        "append :rank=R to fire on one rank only")
+    p.add_argument("--straggler-threshold", type=float, default=None,
+                   dest="straggler_threshold",
+                   help="warn when a step's host-local wait exceeds "
+                        "(threshold-1) x the median step time "
+                        "(utils/fleetobs.py; default 2.0)")
+    p.add_argument("--flightrec-steps", type=int, default=None,
+                   dest="flightrec_steps",
+                   help="flight-recorder ring size: last-N step records "
+                        "dumped on anomaly/preemption/host-loss exits")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   dest="metrics_port",
+                   help="rank-0 Prometheus endpoint port (0 = ephemeral, "
+                        "logged at startup); also enables progress.json")
     p.add_argument("--chaos-seed", type=int, default=None, dest="chaos_seed",
                    help="seed for chaos randomness (defaults to --seed)")
     p.add_argument("--profile-steps", default=None,

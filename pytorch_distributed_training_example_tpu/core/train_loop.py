@@ -176,7 +176,12 @@ def make_train_step(task, grad_accum: int = 1, health: bool = False) -> Callable
     scalars the model sows under the ``"telemetry"`` collection (MoE
     router-load entropy / drop fraction). All on-device; the scalars ride
     the same device_get the loss already takes, so there is no extra host
-    sync — only the small fused reductions inside the step.
+    sync — only the small fused reductions inside the step. Downstream the
+    fetched row feeds the anomaly guard AND the fleet layer: the
+    flight-recorder ring merges it into the matching step record and the
+    per-rank step rows behind the straggler detector ride the same cadence
+    (utils/fleetobs.py) — so fleet observability inherits the same
+    zero-extra-syncs contract.
     """
     from pytorch_distributed_training_example_tpu.utils import (
         telemetry as telemetry_lib)

@@ -37,6 +37,7 @@ from pytorch_distributed_training_example_tpu.models import registry
 from pytorch_distributed_training_example_tpu.parallel import sharding as sharding_lib
 from pytorch_distributed_training_example_tpu.utils import chaos as chaos_lib
 from pytorch_distributed_training_example_tpu.utils import elastic as elastic_lib
+from pytorch_distributed_training_example_tpu.utils import fleetobs
 from pytorch_distributed_training_example_tpu.utils import metrics as metrics_lib
 from pytorch_distributed_training_example_tpu.utils import resilience
 from pytorch_distributed_training_example_tpu.utils import telemetry as telemetry_lib
@@ -69,9 +70,25 @@ class Trainer:
                 tdir, run_id=self.metric_logger.run_id,
                 anomaly_action=cfg.anomaly_action, config=cfg,
                 allow_scaler_skips=(cfg.precision == "fp16"),
-                resume=bool(cfg.resume))
+                resume=bool(cfg.resume),
+                straggler_threshold=cfg.straggler_threshold,
+                flightrec_steps=cfg.flightrec_steps)
             log.info("telemetry on: health pack in metrics, spans/goodput/"
                      "anomaly bundles -> %s", tdir)
+
+        # Live metrics surface (utils/fleetobs.py): Prometheus endpoint on
+        # rank 0 plus an atomically-replaced progress.json in the checkpoint
+        # dir — both fed at the log cadence, so they cost nothing extra.
+        self._metrics_server: fleetobs.MetricsServer | None = None
+        self._progress_dir = cfg.checkpoint_dir or (
+            self.telemetry.directory if self.telemetry is not None else None)
+        self._progress: dict = {}
+        if cfg.metrics_port is not None and distributed.is_main_process():
+            try:
+                self._metrics_server = fleetobs.MetricsServer(
+                    cfg.metrics_port).start()
+            except OSError as e:
+                log.warning("metrics endpoint disabled (%s)", e)
 
         # Chaos harness (utils/chaos.py): armed BEFORE the workload builds so
         # the loader batch hook is installed before any batch is yielded.
@@ -81,7 +98,7 @@ class Trainer:
                 cfg.chaos,
                 seed=(cfg.chaos_seed if cfg.chaos_seed is not None
                       else cfg.seed),
-                log_dir=cfg.checkpoint_dir)
+                log_dir=cfg.checkpoint_dir, rank=jax.process_index())
             loader_lib.set_batch_hook(self._chaos.batch_hook)
             log.warning("chaos harness armed: %s (seed %d)", cfg.chaos,
                         self._chaos.seed)
@@ -460,7 +477,7 @@ class Trainer:
             # an ABRUPT host loss (chaos kill_host, real hardware) writes no
             # shutdown summary, so the restart-tax merge in the next attempt
             # measures its gap from the last flush here.
-            self.telemetry.recorder.write(self.telemetry.directory)
+            self.telemetry.write_artifacts()
 
     # -- resilience --------------------------------------------------------
 
@@ -488,6 +505,11 @@ class Trainer:
                 self._last_saved_step = -1
             self._save(epoch, step_offset=step_offset, block=True)
             log.warning("emergency checkpoint committed — exiting")
+        if self.telemetry is not None:
+            # Post-mortems of preempted runs start from the flight recorder,
+            # not an empty log: dump the last-N step records before exiting.
+            self.telemetry.flight_dump("preempt", epoch=int(epoch),
+                                       step_offset=int(step_offset))
         raise resilience.PreemptedExit()
 
     def _anomaly_rollback(self, epoch: int, i: int) -> int:
@@ -603,6 +625,16 @@ class Trainer:
                 # Shutdown emit runs even on an anomaly abort, so the
                 # timeline + goodput files always reflect the full run.
                 self.telemetry.emit("shutdown")
+            if distributed.is_main_process() and self._progress_dir:
+                try:
+                    fleetobs.write_progress(
+                        self._progress_dir,
+                        {**self._progress, "status": "shutdown"})
+                except OSError:
+                    pass
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
             self.metric_logger.close()
         return self.state
 
@@ -653,7 +685,12 @@ class Trainer:
         it = self._make_step_iter(epoch, self.train_loader.start_batch)
         with mesh_lib.use_mesh(self.mesh):
             i = self.train_loader.start_batch
+            # Per-step host timings for the fleet layer (straggler detection,
+            # flight recorder): pure perf_counter deltas around phases the
+            # loop already runs — no extra device syncs at any cadence.
+            t_iter = time.perf_counter()
             while i < self.steps_per_epoch:
+                t_wait = time.perf_counter()
                 # Host wait on the input pipeline is its own badput bucket —
                 # with the prefetcher keeping up this span is ~0.
                 with self._span("input_wait"):
@@ -661,6 +698,7 @@ class Trainer:
                         batch = next(it)
                     except StopIteration:
                         break
+                input_wait_s = time.perf_counter() - t_wait
                 watchdog.beat()
                 gstep = epoch * self.steps_per_epoch + i
                 if (self.fault_inject
@@ -707,11 +745,12 @@ class Trainer:
                             it.close()
                             i = self._anomaly_rollback(epoch, i)
                             it = self._make_step_iter(epoch, i)
-                            t_step = time.perf_counter()
+                            t_step = t_iter = time.perf_counter()
                             continue
                     if not is_log:
                         self.metric_logger.write(kind="health", epoch=epoch,
                                                  step=gstep, **m)
+                checkpoint_s = 0.0
                 if (cfg.checkpoint_every_steps
                         and (gstep + 1) % cfg.checkpoint_every_steps == 0):
                     # Step-cadence save: records (epoch, steps applied) so
@@ -723,7 +762,9 @@ class Trainer:
                     # guard just flagged (rollback `continue`d, abort
                     # raised) must never be the checkpoint a restart
                     # resumes into.
+                    t_save = time.perf_counter()
                     self._save(epoch, step_offset=i + 1)
+                    checkpoint_s = time.perf_counter() - t_save
                 if is_log:
                     loss_m.update(m["loss"])
                     lr = float(self.schedule(gstep))
@@ -742,6 +783,16 @@ class Trainer:
                     )
                     self.metric_logger.write(kind="train", epoch=epoch, step=gstep,
                                              lr=lr, rate=rate, mfu=mfu, **m)
+                    self._publish(gstep, epoch, m, dt)
+                now = time.perf_counter()
+                if tele is not None:
+                    # Feed the fleet layer every step: flight-recorder ring,
+                    # buffered step rows, live straggler monitor (warn-only).
+                    tele.observe_timing(gstep, total_s=now - t_iter,
+                                        input_wait_s=input_wait_s,
+                                        checkpoint_s=checkpoint_s,
+                                        epoch=epoch)
+                t_iter = now
                 if self._chaos is not None:
                     self._chaos.step_boundary(gstep)
                 # Preemption poll — the ONLY place the SIGTERM flag is acted
@@ -750,6 +801,31 @@ class Trainer:
                 if resilience.preempted():
                     self._graceful_shutdown(epoch, i + 1)
                 i += 1
+
+    def _publish(self, gstep: int, epoch: int, m: dict, dt: float):
+        """Refresh the live metrics surface (rank 0, log cadence): the
+        Prometheus gauges and the atomically-replaced progress.json."""
+        if not distributed.is_main_process() or self._progress_dir is None:
+            return
+        row = {"step": int(gstep), "epoch": int(epoch),
+               "loss": float(m.get("loss", 0.0)), "step_time_s": float(dt)}
+        if self.telemetry is not None:
+            g = self.telemetry.recorder.goodput()
+            row.update(
+                run_id=self.telemetry.run_id,
+                goodput_fraction=g["goodput_fraction"],
+                goodput_coverage=g["coverage"],
+                attempt=g["attempts"],
+                straggler_warnings=self.telemetry.guard.warnings,
+                anomaly_count=self.telemetry.guard.trips)
+        self._progress = row
+        if self._metrics_server is not None:
+            self._metrics_server.update(**row)
+        try:
+            fleetobs.write_progress(self._progress_dir,
+                                    {**row, "status": "training"})
+        except OSError as e:
+            log.warning("progress.json write failed (%s)", e)
 
     def evaluate(self, epoch: int):
         sums: dict[str, float] = {}

@@ -51,7 +51,8 @@ import time
 
 import numpy as np
 
-from pytorch_distributed_training_example_tpu.utils import elastic, resilience
+from pytorch_distributed_training_example_tpu.utils import (
+    elastic, fleetobs, resilience)
 
 log = logging.getLogger("pdtx")
 
@@ -75,10 +76,15 @@ class _Event:
     key: str
     value: int
     fired: bool = False
+    #: None = fire on every process; N = fire only on process/rank N (the
+    #: ``:rank=N`` spec qualifier — e.g. stall ONE rank's loader so the
+    #: fleet-level straggler detector has a definite culprit).
+    rank: int | None = None
 
 
 def parse_spec(spec: str) -> list[_Event]:
-    """Parse ``name@key=value,...`` into events; raises ValueError on junk."""
+    """Parse ``name@key=value[:rank=R],...`` into events; raises ValueError
+    on junk."""
     events = []
     for raw in spec.split(","):
         raw = raw.strip()
@@ -90,18 +96,28 @@ def parse_spec(spec: str) -> list[_Event]:
                 f"unknown chaos event {name!r} in {spec!r}; "
                 f"have {sorted(_SITES)}")
         want_key = _SITES[name]
+        rank: int | None = None
         if cond:
-            key, _, val = cond.partition("=")
+            head, *quals = cond.split(":")
+            key, _, val = head.partition("=")
             if key != want_key or not val.lstrip("-").isdigit():
                 raise ValueError(
-                    f"chaos event {raw!r}: expected {name}@{want_key}=<int>")
+                    f"chaos event {raw!r}: expected "
+                    f"{name}@{want_key}=<int>[:rank=<int>]")
             value = int(val)
+            for qual in quals:
+                qkey, _, qval = qual.partition("=")
+                if qkey != "rank" or not qval.isdigit():
+                    raise ValueError(
+                        f"chaos event {raw!r}: unknown qualifier {qual!r} "
+                        f"(only :rank=<int>)")
+                rank = int(qval)
         elif name == "truncate_ckpt":
             value = 1  # default: corrupt the first committed save
         else:
             raise ValueError(
                 f"chaos event {raw!r} needs @{want_key}=<int>")
-        events.append(_Event(name, want_key, value))
+        events.append(_Event(name, want_key, value, rank=rank))
     if not events:
         raise ValueError(f"empty chaos spec {spec!r}")
     return events
@@ -119,11 +135,13 @@ class ChaosEngine:
     IO_FAILURES = 2   # < retriable_io's default 4 attempts: retry succeeds
     STALL_S = 1.0
 
-    def __init__(self, spec: str, seed: int = 0, log_dir: str | None = None):
+    def __init__(self, spec: str, seed: int = 0, log_dir: str | None = None,
+                 rank: int | None = None):
         self.events = parse_spec(spec)
         self.seed = seed
         self.rng = np.random.RandomState(seed)
         self.log_dir = log_dir
+        self.rank = rank
         self.log_path = (os.path.join(log_dir, CHAOS_LOG)
                          if log_dir else None)
         # Set by the trainer so batch-site events can map (epoch, batch) to
@@ -146,9 +164,27 @@ class ChaosEngine:
 
     # -- bookkeeping --------------------------------------------------------
 
+    def _proc_rank(self) -> int:
+        """This process's rank, resolved lazily: the trainer passes it in;
+        otherwise the launcher env (``PROCESS_ID``), then jax, then 0."""
+        if self.rank is None:
+            pid = os.environ.get("PROCESS_ID", "")
+            if pid.isdigit():
+                self.rank = int(pid)
+            else:
+                try:
+                    import jax
+
+                    self.rank = jax.process_index()
+                except Exception:  # no jax / uninitialized: single process
+                    self.rank = 0
+        return self.rank
+
     def _take(self, name: str, value: int) -> _Event | None:
         for ev in self.events:
             if ev.name == name and ev.value == value and not ev.fired:
+                if ev.rank is not None and ev.rank != self._proc_rank():
+                    continue
                 ev.fired = True
                 return ev
         return None
@@ -193,6 +229,10 @@ class ChaosEngine:
             host = world - 1
         except Exception:  # pragma: no cover - no jax / uninitialized
             pass
+        # Last words: the flight recorder ring is the ONLY diagnostic record
+        # an abrupt loss leaves (no flushes by design — a tiny bounded append
+        # is the one exception, same spirit as the dead-host record below).
+        fleetobs.dump_active("host_loss", step=gstep)
         if self.log_dir:
             elastic.record_dead_host(self.log_dir, host, world=world,
                                      step=gstep, reason="chaos kill_host")

@@ -106,6 +106,17 @@ class Config:
     # watchdog: seconds without step progress before dumping stacks/aborting
     # (utils/watchdog.py; was hardcoded at 1800)
     watchdog_timeout: float = 1800.0
+    # fleet observability (utils/fleetobs.py) — straggler warn threshold:
+    # a step whose host-local wait exceeds (threshold - 1) x the median step
+    # time trips the AnomalyGuard's warn-only trigger and is flagged by the
+    # offline merge (benchmarks/trace_merge.py)
+    straggler_threshold: float = 2.0
+    # flight recorder: step records kept in the ring dumped on anomaly /
+    # preemption / host-loss exits (flightrec*.jsonl)
+    flightrec_steps: int = 256
+    # rank-0 Prometheus endpoint (fleetobs.MetricsServer): None disables,
+    # 0 binds an ephemeral port (logged), N binds :N
+    metrics_port: int | None = None
     # deterministic fault injection (utils/chaos.py): comma-separated spec,
     # e.g. "sigterm@step=7,ckpt_io_error@save=2" — None disables
     chaos: str | None = None

@@ -1,0 +1,628 @@
+"""Fleet observability primitives: stragglers, flight recorder, live metrics.
+
+Everything here is jax-free and stdlib-only (numpy excepted nowhere) so the
+same code runs inside a training rank, inside the (jax-free) supervisor, and
+inside the offline merge CLI (``benchmarks/trace_merge.py``). Four pieces:
+
+1. **Straggler/skew detection** — :func:`detect_stragglers` consumes per-rank
+   step rows (written by the trainer at the existing ``log_every`` cadence;
+   the timings are host-side ``perf_counter`` deltas so they cost zero extra
+   device syncs) and attributes each step's skew to ``input_wait`` vs
+   ``compute`` vs ``checkpoint``. The subtlety: in a gang, collectives
+   equalize *total* step time across ranks — the rank stalled in its host
+   input pipeline and the rank waiting for it in the collective show the same
+   wall time. Attribution therefore keys on the HOST-LOCAL components
+   (input_wait, checkpoint): the rank whose local component is elevated is
+   the cause; elevated compute with flat local components means genuine
+   device skew. :class:`StragglerMonitor` is the live, rank-local version
+   wired into the AnomalyGuard as a warn-only trigger.
+
+2. **Flight recorder** — :class:`FlightRecorder`, a bounded ring of the last
+   N step records (span timings + health-pack norms + router stats). Every
+   diagnostic exit dumps it as ``flightrec*.jsonl``: AnomalyGuard bundles,
+   preemption exit-75, and — via the module-level :func:`dump_active`
+   registry, callable from ``utils/chaos.py`` without holding a Telemetry
+   reference — the abrupt host-loss exit-76.
+
+3. **Live metrics surface** — :class:`MetricsServer`, a stdlib
+   ``http.server`` endpoint serving Prometheus text format, plus
+   :func:`write_progress`, an atomically-replaced ``progress.json`` for
+   scrapers without network access to the pod.
+
+4. **Artifact identity** — :func:`ensure_run_id` persists ONE stable run id
+   in the checkpoint dir (``O_CREAT|O_EXCL``: first writer wins, everyone
+   else reads it back), so every rank and every elastic attempt stamps the
+   same ``run_id`` while keeping its per-attempt ``attempt_id``; the merge
+   CLI and ``check_regression.py --goodput`` refuse to sum artifacts whose
+   run ids differ.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import socket
+import statistics
+import threading
+import time
+
+log = logging.getLogger("pdtx")
+
+#: Version stamped into every telemetry artifact (trace, goodput, step rows,
+#: flight-recorder dumps, progress.json). Bump on breaking layout changes.
+SCHEMA_VERSION = 1
+
+RUN_ID_FILE = "run_id.json"
+PROGRESS_FILE = "progress.json"
+STRAGGLER_FILE = "straggler.jsonl"
+
+#: Step-row components attributed by the straggler detector. ``input_wait``
+#: and ``checkpoint`` are host-local causes; ``compute`` is the residual
+#: (dispatch + device wait at the metrics fetch).
+STEP_COMPONENTS = ("input_wait_s", "compute_s", "checkpoint_s")
+
+
+def host_identity() -> str:
+    """Short hostname for artifact stamps and merge track groups."""
+    try:
+        return socket.gethostname().split(".")[0] or "host"
+    except Exception:  # pragma: no cover - exotic resolver failures
+        return "host"
+
+
+# ---------------------------------------------------------------------------
+# Artifact identity: one stable run id per checkpoint dir.
+# ---------------------------------------------------------------------------
+
+
+def ensure_run_id(directory: str, fallback: str, *, fresh: bool = False,
+                  rank: int = 0, timeout_s: float = 10.0) -> str:
+    """Return the directory's stable run id, creating it on rank 0.
+
+    Rank 0 owns the file: on a fresh (non-resume) run it replaces any stale
+    id from a previous experiment, then creates atomically
+    (``O_CREAT|O_EXCL`` + a pre-write temp name would be overkill: the
+    payload is one ``write``). Other ranks only ever READ, polling briefly
+    for rank 0 to get there first — ``jax.distributed.initialize`` has
+    already barriered the gang, so the skew is milliseconds. This ordering
+    (never rank>0-creates) is what makes the fresh-run replacement race-free.
+
+    ``fallback`` (the per-process attempt uuid) is returned when there is no
+    directory, or when the file never appears (single-process tests, a
+    supervisor-less rank>0 with a dead rank 0) — artifacts are then stamped
+    per-process only.
+    """
+    if not directory:
+        return fallback
+    path = os.path.join(directory, RUN_ID_FILE)
+    os.makedirs(directory, exist_ok=True)
+    if rank == 0:
+        if fresh:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+            with os.fdopen(fd, "w") as fh:
+                fh.write(json.dumps({
+                    "schema_version": SCHEMA_VERSION, "run_id": fallback,
+                    "host": host_identity(), "time": time.time()}))
+            return fallback
+        except FileExistsError:
+            pass  # resume: a previous attempt's id survives — read it
+        except OSError as e:
+            log.warning("fleetobs: cannot create %s (%s) — per-process "
+                        "run id %s", path, e, fallback)
+            return fallback
+    deadline = time.monotonic() + (timeout_s if rank else 1.0)
+    while True:
+        try:
+            with open(path) as fh:
+                return str(json.load(fh)["run_id"])
+        except (OSError, ValueError, KeyError):
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+    log.warning("fleetobs: no readable %s — falling back to per-process "
+                "run id %s", path, fallback)
+    return fallback
+
+
+# ---------------------------------------------------------------------------
+# Torn-tolerant JSONL + atomic JSON helpers.
+# ---------------------------------------------------------------------------
+
+
+def read_jsonl_tolerant(path: str) -> list[dict]:
+    """Parse a JSONL file, skipping unparseable lines (torn tails from a
+    killed host) exactly like ``utils/elastic.read_dead_hosts``."""
+    rows: list[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def write_json_atomic(path: str, payload: dict) -> None:
+    """Write via temp file + ``os.replace`` so readers never see a torn file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1, default=float)
+    os.replace(tmp, path)
+
+
+def write_progress(directory: str, payload: dict) -> str:
+    """Atomically replace ``progress.json`` (rank 0, log cadence)."""
+    path = os.path.join(directory, PROGRESS_FILE)
+    os.makedirs(directory, exist_ok=True)
+    row = {"schema_version": SCHEMA_VERSION, "time": time.time(), **payload}
+    write_json_atomic(path, row)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Straggler / skew detection.
+# ---------------------------------------------------------------------------
+
+
+def _component(row: dict, key: str) -> float:
+    try:
+        return max(0.0, float(row.get(key, 0.0) or 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def detect_stragglers(rows_by_rank: dict[int, list[dict]],
+                      threshold: float = 2.0,
+                      abs_floor_s: float = 0.05) -> list[dict]:
+    """Offline per-step skew attribution across ranks.
+
+    For every step present on >= 2 ranks, the rank with the largest
+    host-local excess (input_wait + checkpoint above the per-component
+    cross-rank minimum) is the candidate straggler; when no local component
+    is elevated the candidate is the rank with the slowest total (genuine
+    device/compute skew). A step is ``flagged`` when the candidate's delta
+    exceeds both ``abs_floor_s`` and ``(threshold - 1) x`` the fleet-typical
+    step time (median of ALL rank-step totals — robust to the handful of
+    stalled steps being diagnosed).
+
+    Returns one row per multi-rank step, sorted by step::
+
+        {"step", "slowest_rank", "delta_s", "typical_s", "cause",
+         "flagged", "attribution": {"input_wait_s": ..., "compute_s": ...,
+         "checkpoint_s": ...}, "ranks": N}
+    """
+    by_step: dict[int, dict[int, dict]] = {}
+    totals_all: list[float] = []
+    for rank, rows in rows_by_rank.items():
+        for row in rows:
+            step = row.get("step")
+            if step is None:
+                continue
+            by_step.setdefault(int(step), {})[int(rank)] = row
+            totals_all.append(_component(row, "total_s"))
+    if not totals_all:
+        return []
+    typical = statistics.median(totals_all)
+
+    out: list[dict] = []
+    for step in sorted(by_step):
+        ranks = by_step[step]
+        if len(ranks) < 2:
+            continue
+        mins = {c: min(_component(r, c) for r in ranks.values())
+                for c in STEP_COMPONENTS}
+        local_excess = {
+            rank: (_component(row, "input_wait_s") - mins["input_wait_s"])
+            + (_component(row, "checkpoint_s") - mins["checkpoint_s"])
+            for rank, row in ranks.items()}
+        slow_local = max(local_excess, key=local_excess.get)
+        totals = {rank: _component(row, "total_s")
+                  for rank, row in ranks.items()}
+        slow_total = max(totals, key=totals.get)
+        total_skew = totals[slow_total] - min(totals.values())
+
+        if local_excess[slow_local] >= max(abs_floor_s, 0.5 * total_skew):
+            slowest, delta = slow_local, local_excess[slow_local]
+        else:
+            # No host-local cause: collectives hide who is slow locally, so
+            # fall back to the total-time spread (device skew, unsynced run).
+            slowest, delta = slow_total, total_skew
+        row = ranks[slowest]
+        attribution = {c: round(_component(row, c) - mins[c], 6)
+                       for c in STEP_COMPONENTS}
+        cause = max(attribution, key=attribution.get)
+        flagged = (delta > abs_floor_s
+                   and delta > max(0.0, threshold - 1.0) * typical)
+        out.append({
+            "step": step,
+            "slowest_rank": slowest,
+            "delta_s": round(delta, 6),
+            "typical_s": round(typical, 6),
+            "cause": cause,
+            "flagged": bool(flagged),
+            "attribution": attribution,
+            "ranks": len(ranks),
+        })
+    return out
+
+
+def write_stragglers(directory: str, rows: list[dict]) -> str:
+    path = os.path.join(directory, STRAGGLER_FILE)
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row, default=float) + "\n")
+    return path
+
+
+class StragglerMonitor:
+    """Live, rank-local input-stall detector (warn-only AnomalyGuard trigger).
+
+    A single rank cannot see the fleet, but it CAN see its own host-local
+    input_wait spike against its own recent step times — the signature of a
+    stalled data pipeline (the fleet-level attribution of the same event is
+    the offline :func:`detect_stragglers`). Checkpoint time is excluded:
+    cadence saves are legitimate local work, not a straggle.
+    """
+
+    def __init__(self, threshold: float = 2.0, window: int = 32,
+                 min_window: int = 3, abs_floor_s: float = 0.05):
+        self.threshold = float(threshold)
+        self.abs_floor_s = abs_floor_s
+        self.min_window = min_window
+        self._totals: collections.deque = collections.deque(maxlen=window)
+        self.warnings = 0
+
+    def observe(self, step: int, *, total_s: float,
+                input_wait_s: float) -> str | None:
+        """Feed one step; returns a warn reason when the step straggled."""
+        reason = None
+        if len(self._totals) >= self.min_window:
+            typical = statistics.median(self._totals)
+            bar = max(self.abs_floor_s,
+                      max(0.0, self.threshold - 1.0) * typical)
+            if input_wait_s > bar:
+                self.warnings += 1
+                reason = (f"input_wait {input_wait_s:.3f}s at step {step} "
+                          f"exceeds {bar:.3f}s "
+                          f"(threshold {self.threshold:g}x median "
+                          f"{typical:.3f}s)")
+        # Record AFTER the check so a stall doesn't poison its own baseline;
+        # record the total regardless so the window keeps moving.
+        self._totals.append(max(0.0, float(total_s)))
+        return reason
+
+
+class StepRowWriter:
+    """Buffered appender for per-rank step rows (``steprows.r<R>.a<A>.jsonl``).
+
+    Rows are buffered in memory and appended in batches (log cadence /
+    shutdown / atexit) — one ``write`` per flush, so a killed host tears at
+    most the final line, which :func:`read_jsonl_tolerant` skips.
+    """
+
+    def __init__(self, directory: str, rank: int, attempt: int,
+                 meta: dict | None = None, flush_every: int = 32):
+        self.path = os.path.join(directory,
+                                 f"steprows.r{rank}.a{attempt}.jsonl")
+        self.flush_every = max(1, int(flush_every))
+        self._pending: list[dict] = [
+            {"schema_version": SCHEMA_VERSION, "rank": rank,
+             "attempt": attempt, "host": host_identity(), **(meta or {})}]
+
+    def add(self, row: dict) -> None:
+        self._pending.append(row)
+        if len(self._pending) >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        rows, self._pending = self._pending, []
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a") as fh:
+                fh.write("".join(json.dumps(r, default=float) + "\n"
+                                 for r in rows))
+        except OSError as e:  # diagnostics never take down training
+            log.warning("steprow flush failed (%s)", e)
+
+
+def steprow_files(directory: str) -> dict[int, list[str]]:
+    """Per-rank steprow files under ``directory``, attempt-sorted."""
+    found: dict[int, list[tuple[int, str]]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return {}
+    for name in names:
+        m = re.fullmatch(r"steprows\.r(\d+)\.a(\d+)\.jsonl", name)
+        if m:
+            found.setdefault(int(m.group(1)), []).append(
+                (int(m.group(2)), os.path.join(directory, name)))
+    return {rank: [p for _, p in sorted(pairs)]
+            for rank, pairs in sorted(found.items())}
+
+
+def load_steprows(directory: str) -> dict[int, list[dict]]:
+    """All ranks' step rows, later attempts overriding replayed steps."""
+    out: dict[int, list[dict]] = {}
+    for rank, paths in steprow_files(directory).items():
+        by_step: dict[int, dict] = {}
+        for path in paths:  # attempt order: later attempts win on replay
+            for row in read_jsonl_tolerant(path):
+                if "step" in row:
+                    by_step[int(row["step"])] = row
+        out[rank] = [by_step[s] for s in sorted(by_step)]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of the last N step records, dumped on diagnostic exits.
+
+    ``record_timing`` adds one row per step (host span timings);
+    ``record_health`` merges the health-pack fetch (loss, norms, router
+    stats) into the matching step's row — the two arrive from different
+    call sites in the trainer loop. ``dump`` appends a header + the rows to
+    ``flightrec.jsonl`` (rank 0) / ``flightrec.r<rank>.jsonl``, append-mode
+    so an anomaly dump followed by a preemption dump keeps both.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record_timing(self, step: int, **fields) -> None:
+        with self._lock:
+            self._ring.append({"step": int(step), **fields})
+
+    def record_health(self, step: int, row: dict) -> None:
+        clean = {k: v for k, v in row.items()
+                 if isinstance(v, (int, float, str, bool)) or v is None}
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.get("step") == int(step):
+                    rec.update(clean)
+                    return
+            self._ring.append({"step": int(step), **clean})
+
+    def rows(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def dump(self, directory: str, *, reason: str, rank: int = 0,
+             meta: dict | None = None) -> str | None:
+        """Append the ring to the per-rank flightrec file; best-effort (the
+        host-loss path calls this from ``os._exit`` territory)."""
+        rows = self.rows()
+        name = ("flightrec.jsonl" if rank == 0
+                else f"flightrec.r{rank}.jsonl")
+        path = os.path.join(directory, name)
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "a") as fh:
+                header = {"flightrec": reason, "schema_version": SCHEMA_VERSION,
+                          "rank": rank, "host": host_identity(),
+                          "records": len(rows), "time": time.time(),
+                          **(meta or {})}
+                fh.write(json.dumps(header, default=float) + "\n")
+                for row in rows:
+                    fh.write(json.dumps(row, default=float) + "\n")
+            return path
+        except Exception as e:  # never let diagnostics kill the exit path
+            log.warning("flight recorder dump failed (%s: %s)",
+                        type(e).__name__, e)
+            return None
+
+
+#: Active recorder registry: (recorder, directory, rank, meta). Lets code
+#: with no Telemetry reference — chaos ``kill_host`` just before
+#: ``os._exit(76)`` — dump the ring of whatever run is live in this process.
+_active: tuple[FlightRecorder, str, int, dict] | None = None
+
+
+def set_active(recorder: FlightRecorder | None, directory: str = "",
+               rank: int = 0, meta: dict | None = None) -> None:
+    global _active
+    _active = ((recorder, directory, rank, dict(meta or {}))
+               if recorder is not None and directory else None)
+
+
+def dump_active(reason: str, **extra) -> str | None:
+    """Dump the registered recorder (no-op when none is live)."""
+    if _active is None:
+        return None
+    recorder, directory, rank, meta = _active
+    return recorder.dump(directory, reason=reason, rank=rank,
+                         meta={**meta, **extra})
+
+
+# ---------------------------------------------------------------------------
+# Live metrics surface.
+# ---------------------------------------------------------------------------
+
+_METRIC_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+class MetricsServer:
+    """Stdlib-only Prometheus endpoint on rank 0 (``--metrics-port``).
+
+    ``GET /metrics`` renders the current gauges in Prometheus text format
+    (all ``pdtx_``-prefixed); ``GET /progress`` returns them as JSON. Gauges
+    are updated from the trainer at the log cadence — the server thread
+    never touches jax state, just a dict under a lock. ``port=0`` binds an
+    ephemeral port (tests); the bound port is in ``.port`` after
+    :meth:`start`.
+    """
+
+    def __init__(self, port: int = 0, addr: str = "0.0.0.0"):
+        self.requested_port = int(port)
+        self.addr = addr
+        self.port: int | None = None
+        self._gauges: dict[str, float] = {}
+        self._info: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread: threading.Thread | None = None
+
+    def update(self, **gauges) -> None:
+        with self._lock:
+            for key, val in gauges.items():
+                if isinstance(val, bool) or val is None:
+                    continue
+                if isinstance(val, (int, float)):
+                    self._gauges[_METRIC_RE.sub("_", str(key))] = float(val)
+                else:
+                    self._info[_METRIC_RE.sub("_", str(key))] = str(val)
+
+    def render(self) -> str:
+        with self._lock:
+            gauges = dict(self._gauges)
+            info = dict(self._info)
+        lines = []
+        if info:
+            labels = ",".join(f'{k}="{v}"' for k, v in sorted(info.items()))
+            lines += ["# TYPE pdtx_run_info gauge",
+                      f"pdtx_run_info{{{labels}}} 1"]
+        for key in sorted(gauges):
+            val = gauges[key]
+            if val != val:  # Prometheus spells non-finite values its own way
+                text = "NaN"
+            elif val in (float("inf"), float("-inf")):
+                text = "+Inf" if val > 0 else "-Inf"
+            else:
+                text = repr(val)
+            lines += [f"# TYPE pdtx_{key} gauge", f"pdtx_{key} {text}"]
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {**self._info, **self._gauges}
+
+    def start(self) -> "MetricsServer":
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    body = server.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.rstrip("/") == "/progress":
+                    body = json.dumps(server.snapshot(),
+                                      default=float).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes are not log lines
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.addr, self.requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.5},
+            name="pdtx-metrics", daemon=True)
+        self._thread.start()
+        log.info("metrics endpoint: http://%s:%d/metrics",
+                 self.addr, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Fleet goodput aggregation (used by the merge CLI; pure + unit-testable).
+# ---------------------------------------------------------------------------
+
+
+def aggregate_goodput(per_rank: dict[int, dict]) -> dict:
+    """Fold per-rank cumulative goodput summaries into one fleet summary.
+
+    Each input is the FINAL (highest-attempt) goodput dict of one rank, so
+    category seconds are averaged (every rank spans the same wall-clock; the
+    mean is the fleet's per-host decomposition), fractions are recomputed
+    from the averaged decomposition, and attempts is the max seen.
+    """
+    ranks = sorted(per_rank)
+    if not ranks:
+        return {}
+    n = len(ranks)
+    wall = sum(float(per_rank[r].get("wall_s") or 0.0) for r in ranks) / n
+    cats: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    run_ids: list[str] = []
+    attempts = 1
+    for r in ranks:
+        g = per_rank[r]
+        for k, v in (g.get("categories_s") or {}).items():
+            cats[k] = cats.get(k, 0.0) + float(v) / n
+        for k, v in (g.get("counts") or {}).items():
+            counts[k] = max(counts.get(k, 0), int(v))
+        rid = g.get("run_id")
+        if rid and rid not in run_ids:
+            run_ids.append(rid)
+        attempts = max(attempts, int(g.get("attempts") or 1))
+    wall = max(wall, 1e-9)
+    fracs = {k: v / wall for k, v in cats.items()}
+    good = sum(fracs.get(k, 0.0) for k in ("step",))
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "run_id": run_ids[0] if len(run_ids) == 1 else None,
+        "run_ids": run_ids,
+        "ranks": ranks,
+        "wall_s": round(wall, 4),
+        "categories_s": {k: round(v, 4) for k, v in sorted(cats.items())},
+        "counts": counts,
+        "fractions": {k: round(v, 4) for k, v in sorted(fracs.items())},
+        "goodput_fraction": round(good, 4),
+        "badput_fraction": round(sum(fracs.values()) - good, 4),
+        "coverage": round(sum(fracs.values()), 4),
+        "attempts": attempts,
+        "per_rank": {str(r): {
+            "goodput_fraction": per_rank[r].get("goodput_fraction"),
+            "coverage": per_rank[r].get("coverage"),
+            "wall_s": per_rank[r].get("wall_s"),
+            "host": (per_rank[r].get("meta") or {}).get("host"),
+        } for r in ranks},
+    }

@@ -39,13 +39,18 @@ log = logging.getLogger("pdtx")
 #: Exit code of a graceful preemption shutdown. 75 is EX_TEMPFAIL ("temporary
 #: failure, try again later") — the supervisor's restart predicate, and
 #: distinct from the fault injector's hard-kill (57) and ordinary crashes.
+#: Both diagnostic exits (75 and 76) dump the flight-recorder ring
+#: (``utils/fleetobs.py`` -> ``flightrec*.jsonl``) on the way out, so a
+#: post-mortem never starts from an empty log.
 PREEMPTED_EXIT_CODE = 75
 
 #: Exit code of an *abrupt* simulated host loss (chaos ``kill_host``): the
-#: process dies without an emergency checkpoint, exactly like real hardware.
-#: An elastic supervisor (``launch.py --elastic``) treats it as restartable
-#: — at a smaller world size, per the dead-host records (``utils/elastic``);
-#: a fixed-gang supervisor only restarts it under ``on-failure``.
+#: process dies without an emergency checkpoint, exactly like real hardware
+#: (the one exception: two tiny bounded appends — the dead-host record and
+#: the flight-recorder dump). An elastic supervisor (``launch.py --elastic``)
+#: treats it as restartable — at a smaller world size, per the dead-host
+#: records (``utils/elastic``); a fixed-gang supervisor only restarts it
+#: under ``on-failure``.
 HOST_LOST_EXIT_CODE = 76
 
 _flag = threading.Event()

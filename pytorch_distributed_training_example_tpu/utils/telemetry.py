@@ -27,6 +27,7 @@ The :class:`Telemetry` facade bundles all three for ``core/trainer.py``.
 
 from __future__ import annotations
 
+import atexit
 import collections
 import contextlib
 import dataclasses
@@ -38,6 +39,8 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+
+from pytorch_distributed_training_example_tpu.utils import fleetobs
 
 log = logging.getLogger("pdtx")
 
@@ -137,9 +140,19 @@ class SpanRecorder:
     captured by ``--profile-steps``.
     """
 
-    def __init__(self, run_id: str = "", carry: dict | None = None):
+    def __init__(self, run_id: str = "", carry: dict | None = None,
+                 meta: dict | None = None):
         self.run_id = run_id
+        # Monotonic<->wall anchor, captured at the same instant: ``ts``
+        # values in the trace are microseconds after ``_start`` on THIS
+        # host's monotonic clock; ``_wall_origin`` places that origin on the
+        # shared wall clock so the merge CLI can align ranks whose monotonic
+        # clocks have arbitrary offsets.
         self._start = time.perf_counter()
+        self._wall_origin = time.time()
+        self.meta = dict(meta or {})
+        self._run_ids: list[str] = []
+        self._attempt_ids: list[str] = []
         self._events: list[dict] = []
         self._totals: collections.defaultdict = collections.defaultdict(float)
         self._counts: collections.defaultdict = collections.defaultdict(int)
@@ -162,6 +175,17 @@ class SpanRecorder:
                                  (carry.get("counts") or {}).items()}
             self._base_wall = float(carry.get("wall_s") or 0.0)
             self.attempts = int(carry.get("attempts") or 1) + 1
+            # Provenance across attempts: which run/attempt ids this
+            # cumulative summary merged (mixed-run detection downstream).
+            for rid in (carry.get("run_ids")
+                        or ([carry["run_id"]] if carry.get("run_id") else [])):
+                if rid and rid not in self._run_ids:
+                    self._run_ids.append(rid)
+            for aid in (carry.get("attempt_ids")
+                        or ([carry["attempt_id"]]
+                            if carry.get("attempt_id") else [])):
+                if aid and aid not in self._attempt_ids:
+                    self._attempt_ids.append(aid)
             ended = carry.get("ended_at")
             if ended is not None:
                 gap = max(0.0, time.time() - float(ended))
@@ -175,6 +199,12 @@ class SpanRecorder:
                     "name": "restart", "ph": "X", "cat": "telemetry",
                     "ts": -int(gap * 1e6), "dur": int(gap * 1e6),
                     "pid": self._pid, "tid": 0})
+        if run_id and run_id not in self._run_ids:
+            self._run_ids.append(run_id)
+        aid = self.meta.get("attempt_id")
+        if aid and aid not in self._attempt_ids:
+            self._attempt_ids.append(aid)
+        self.meta.setdefault("attempt", self.attempts)
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -203,9 +233,18 @@ class SpanRecorder:
         return time.perf_counter() - self._start
 
     def trace_events(self) -> dict:
-        return {"traceEvents": list(self._events),
+        # ``otherData`` (identity stamps + clock anchor) deliberately comes
+        # FIRST: json.dump preserves insertion order, so a file torn mid-write
+        # by a killed host loses trailing *events*, never the header the
+        # merge CLI needs to salvage the prefix.
+        return {"otherData": {
+                    "schema_version": fleetobs.SCHEMA_VERSION,
+                    "run_id": self.run_id,
+                    **self.meta,
+                    "clock_anchor": {"wall": self._wall_origin,
+                                     "monotonic": self._start}},
                 "displayTimeUnit": "ms",
-                "otherData": {"run_id": self.run_id}}
+                "traceEvents": list(self._events)}
 
     def goodput(self) -> dict:
         """Wall-clock decomposition since construction (plus carried attempts).
@@ -228,8 +267,10 @@ class SpanRecorder:
         cats = {k: round(v, 4) for k, v in sorted(totals.items())}
         fracs = {k: v / wall for k, v in totals.items()}
         good = sum(fracs.get(k, 0.0) for k in PRODUCTIVE_SPANS)
-        return {
+        out = {
+            "schema_version": fleetobs.SCHEMA_VERSION,
             "run_id": self.run_id,
+            "run_ids": list(self._run_ids),
             "wall_s": round(wall, 4),
             "categories_s": cats,
             "counts": counts,
@@ -240,17 +281,57 @@ class SpanRecorder:
             "attempts": self.attempts,
             "ended_at": round(time.time(), 3),
         }
+        if self.meta:
+            out["meta"] = dict(self.meta)
+        if self.meta.get("attempt_id"):
+            out["attempt_id"] = self.meta["attempt_id"]
+            out["attempt_ids"] = list(self._attempt_ids)
+        return out
 
     def write(self, directory: str) -> None:
+        """The rank-0 (single-process-compatible) artifact pair."""
         os.makedirs(directory, exist_ok=True)
         with open(os.path.join(directory, "trace_events.json"), "w") as fh:
             json.dump(self.trace_events(), fh)
-        with open(os.path.join(directory, "goodput.json"), "w") as fh:
-            json.dump(self.goodput(), fh, indent=1)
+        fleetobs.write_json_atomic(os.path.join(directory, "goodput.json"),
+                                   self.goodput())
+
+    def write_rank(self, directory: str, rank: int, attempt: int) -> None:
+        """Per-rank, per-attempt artifact pair — every rank writes its own
+        (the plain names above are rank 0's; before this, N ranks clobbered
+        one shared file and the merge had nothing to merge)."""
+        os.makedirs(directory, exist_ok=True)
+        suffix = f"r{rank}.a{attempt}"
+        path = os.path.join(directory, f"trace_events.{suffix}.json")
+        with open(path, "w") as fh:
+            json.dump(self.trace_events(), fh)
+        fleetobs.write_json_atomic(
+            os.path.join(directory, f"goodput.{suffix}.json"), self.goodput())
 
 
-def load_goodput(directory: str) -> dict | None:
-    """Previous attempt's goodput summary (None if absent/unparseable)."""
+def load_goodput(directory: str, rank: int = 0) -> dict | None:
+    """Previous attempt's cumulative goodput for ``rank`` (None if absent).
+
+    Rank 0 reads the plain ``goodput.json``; other ranks read their
+    highest-attempt suffixed file, falling back to the plain file (resume
+    from a run that predates per-rank artifacts)."""
+    import re as _re
+
+    if rank:
+        best: tuple[int, str] | None = None
+        try:
+            for name in os.listdir(directory):
+                m = _re.fullmatch(rf"goodput\.r{rank}\.a(\d+)\.json", name)
+                if m and (best is None or int(m.group(1)) > best[0]):
+                    best = (int(m.group(1)), name)
+        except OSError:
+            best = None
+        if best is not None:
+            try:
+                with open(os.path.join(directory, best[1])) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                return None
     try:
         with open(os.path.join(directory, "goodput.json")) as fh:
             return json.load(fh)
@@ -303,6 +384,16 @@ class AnomalyGuard:
         self.allow_scaler_skips = allow_scaler_skips
         self.history: collections.deque = collections.deque(maxlen=keep)
         self.tripped = False
+        self.trips = 0
+        self.warnings = 0
+        # Optional hook called as ``fn(reason, step=...)`` after a bundle is
+        # written — the Telemetry facade points it at the flight recorder.
+        # Dumped once per anomaly EPISODE (a run of anomalous checks with no
+        # clean row in between), not per anomalous step: under
+        # anomaly_action=continue a NaN that sticks in the params would
+        # otherwise append a near-identical ring dump every step.
+        self.flight_dump_fn: Callable[..., Any] | None = None
+        self._in_anomaly_episode = False
 
     def record(self, step: int, row: dict) -> None:
         self.history.append({"step": int(step), **row})
@@ -315,8 +406,10 @@ class AnomalyGuard:
             return False  # fp16 overflow-skip: params held, not an anomaly
         bad = _nonfinite_keys(row)
         if not bad:
+            self._in_anomaly_episode = False
             return False
         self.tripped = True
+        self.trips += 1
         path = self.dump(step, row, bad)
         msg = (f"non-finite health scalar(s) {bad} at step {step}; "
                f"diagnostic bundle: {path}")
@@ -328,11 +421,21 @@ class AnomalyGuard:
         log.error("anomaly guard: %s — anomaly_action=%s", msg, self.action)
         return True
 
+    def warn(self, step: int, reason: str) -> None:
+        """Warn-only trigger (straggler/skew detection): counted and kept in
+        the history ring so the next bundle shows it, but never dumps or
+        aborts on its own — a slow host is an operator page, not a rollback.
+        """
+        self.warnings += 1
+        self.history.append({"step": int(step), "warn": reason})
+        log.warning("anomaly guard [warn-only] step %d: %s", int(step), reason)
+
     def dump(self, step: int, row: dict, bad_keys: list[str]) -> str:
         cfg = self.config
         if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
             cfg = dataclasses.asdict(cfg)
         bundle = {
+            "schema_version": fleetobs.SCHEMA_VERSION,
             "run_id": self.run_id,
             "step": int(step),
             "trigger_keys": bad_keys,
@@ -346,6 +449,12 @@ class AnomalyGuard:
         path = os.path.join(self.directory, f"anomaly_step{int(step):08d}.json")
         with open(path, "w") as fh:
             json.dump(bundle, fh, indent=1, default=float)
+        if self.flight_dump_fn is not None and not self._in_anomaly_episode:
+            try:
+                self.flight_dump_fn("anomaly", step=int(step))
+            except Exception as e:  # diagnostics never mask the anomaly
+                log.warning("flight dump on anomaly failed: %s", e)
+        self._in_anomaly_episode = True
         return path
 
 
@@ -365,16 +474,38 @@ class Telemetry:
     def __init__(self, directory: str, run_id: str = "",
                  anomaly_action: str = "abort", config: Any = None,
                  history_keep: int = 32, allow_scaler_skips: bool = False,
-                 resume: bool = False):
+                 resume: bool = False, straggler_threshold: float = 2.0,
+                 flightrec_steps: int = 256):
         self.directory = directory
+        self.rank = jax.process_index()
+        self.host = fleetobs.host_identity()
+        # ``run_id`` (the MetricLogger per-process uuid) is really the
+        # ATTEMPT id; the fleet-stable run id lives in <dir>/run_id.json so
+        # every rank and every elastic attempt stamps the same one.
+        self.attempt_id = run_id
+        self.run_id = fleetobs.ensure_run_id(
+            directory, run_id, fresh=not resume, rank=self.rank)
         # ``resume=True`` (a --resume run, e.g. a supervisor relaunch) merges
         # a previous attempt's goodput.json into this one: cumulative
         # categories plus a "restart" badput interval for the gap. The file
         # in ``directory`` then always decomposes the whole job so far.
-        carry = load_goodput(directory) if resume else None
-        if carry and carry.get("run_id") == run_id:
+        carry = load_goodput(directory, rank=self.rank) if resume else None
+        if carry and (carry.get("attempt_id") == self.attempt_id
+                      or carry.get("run_id") == run_id):
             carry = None  # same attempt rewriting its own file: nothing to merge
-        self.recorder = SpanRecorder(run_id=run_id, carry=carry)
+        elif (carry and carry.get("schema_version")
+              and carry.get("run_id") != self.run_id):
+            # Stamped artifact from a DIFFERENT run in the same directory —
+            # summing unrelated attempts would fabricate goodput. Refuse.
+            log.warning(
+                "telemetry: refusing to carry goodput from foreign run %s "
+                "into run %s (stale artifacts in %s?)",
+                carry.get("run_id"), self.run_id, directory)
+            carry = None
+        meta = {"host": self.host, "rank": self.rank,
+                "attempt_id": self.attempt_id}
+        self.recorder = SpanRecorder(run_id=self.run_id, carry=carry,
+                                     meta=meta)
         if carry:
             log.info(
                 "telemetry: merging goodput across supervisor attempts — "
@@ -382,10 +513,28 @@ class Telemetry:
                 self.recorder.attempts, carry.get("wall_s", 0.0))
         self.guard = AnomalyGuard(
             directory, action=anomaly_action, keep=history_keep,
-            config=config, run_id=run_id, goodput_fn=self.recorder.goodput,
+            config=config, run_id=self.run_id,
+            goodput_fn=self.recorder.goodput,
             allow_scaler_skips=allow_scaler_skips)
+        self.guard.flight_dump_fn = self.flight_dump
+        self.flight = fleetobs.FlightRecorder(flightrec_steps)
+        self.monitor = fleetobs.StragglerMonitor(threshold=straggler_threshold)
+        self._steprows = (fleetobs.StepRowWriter(
+            directory, self.rank, self.recorder.attempts,
+            meta={"run_id": self.run_id, "attempt_id": self.attempt_id})
+            if directory else None)
+        fleetobs.set_active(
+            self.flight, directory, self.rank,
+            meta={"run_id": self.run_id, "attempt_id": self.attempt_id,
+                  "attempt": self.recorder.attempts})
         self.last_step: int | None = None
         self.last_health: dict | None = None
+        # Satellite fix (host-loss flush gap): a surviving rank torn down by
+        # the launcher after a peer's abrupt death may never reach the
+        # trainer's finally — flush the tail spans at interpreter exit so
+        # only the genuinely-killed host loses data.
+        self._atexit_armed = True
+        atexit.register(self._atexit_flush)
 
     def span(self, name: str):
         return self.recorder.span(name)
@@ -394,16 +543,73 @@ class Telemetry:
         """Feed one fetched metrics row; returns True if the guard tripped."""
         self.last_step = int(step)
         self.last_health = dict(row)
+        # Into the flight recorder FIRST: if the guard trips on this row its
+        # bundle-adjacent flightrec dump must already contain the trigger.
+        self.flight.record_health(step, row)
         return self.guard.check(step, row)
+
+    def observe_timing(self, step: int, *, total_s: float,
+                       input_wait_s: float = 0.0, checkpoint_s: float = 0.0,
+                       epoch: int | None = None) -> str | None:
+        """Feed one step's host-side phase timings (every step — pure
+        ``perf_counter`` deltas, no device syncs). Returns the warn reason
+        when the live straggler monitor flags the step."""
+        compute = max(0.0, total_s - input_wait_s - checkpoint_s)
+        row = {"step": int(step), "t": round(time.time(), 3),
+               "total_s": round(total_s, 6),
+               "input_wait_s": round(input_wait_s, 6),
+               "compute_s": round(compute, 6),
+               "checkpoint_s": round(checkpoint_s, 6)}
+        if epoch is not None:
+            row["epoch"] = int(epoch)
+        self.flight.record_timing(step, **{k: v for k, v in row.items()
+                                           if k != "step"})
+        if self._steprows is not None:
+            self._steprows.add(row)
+        reason = self.monitor.observe(step, total_s=total_s,
+                                      input_wait_s=input_wait_s)
+        if reason:
+            self.guard.warn(step, reason)
+        return reason
+
+    def flight_dump(self, reason: str, **extra) -> str | None:
+        """Dump the flight-recorder ring (anomaly / preempt / shutdown)."""
+        return self.flight.dump(
+            self.directory, reason=reason, rank=self.rank,
+            meta={"run_id": self.run_id, "attempt_id": self.attempt_id,
+                  "attempt": self.recorder.attempts, **extra})
+
+    def write_artifacts(self) -> None:
+        """Flush every on-disk artifact this rank owns: the per-rank trace/
+        goodput pair (all ranks), the legacy plain pair (rank 0 only — N
+        ranks used to clobber one shared file), and buffered step rows."""
+        self.recorder.write_rank(self.directory, self.rank,
+                                 self.recorder.attempts)
+        if self.rank == 0:
+            self.recorder.write(self.directory)
+        if self._steprows is not None:
+            self._steprows.flush()
+
+    def _atexit_flush(self) -> None:
+        if not self._atexit_armed:
+            return
+        self._atexit_armed = False
+        try:
+            self.write_artifacts()
+        except Exception:  # interpreter teardown: never raise
+            pass
 
     def snapshot(self) -> dict:
         return {"last_step": self.last_step,
                 "last_health": self.last_health,
+                "straggler_warnings": self.guard.warnings,
                 "goodput": self.recorder.goodput()}
 
     def emit(self, where: str = "") -> dict:
         """Write the timeline + goodput files and log the one-line summary."""
-        self.recorder.write(self.directory)
+        self.write_artifacts()
+        if where == "shutdown":
+            self._atexit_armed = False
         g = self.recorder.goodput()
         log.info(
             "goodput%s: %.1f%% productive over %.1fs (coverage %.1f%%) — %s",

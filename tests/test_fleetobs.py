@@ -1,0 +1,381 @@
+"""Fleet observability (utils/fleetobs.py + benchmarks/trace_merge.py):
+clock-aligned cross-host trace merge, straggler attribution, flight
+recorder, live metrics surface, artifact identity. Everything here is
+jax-free — the same property the modules themselves promise."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from pytorch_distributed_training_example_tpu.utils import chaos as chaos_lib
+from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import trace_merge  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Fixture builders: a synthetic 2-host x 2-attempt artifact directory.
+# ---------------------------------------------------------------------------
+
+RUN = "run-aabbcc"
+
+
+def _trace_doc(host, rank, attempt, wall_origin, spans, run_id=RUN):
+    """A telemetry-shaped trace file: otherData FIRST (the salvage contract),
+    spans as (name, start_us, dur_us) complete events."""
+    return {
+        "otherData": {
+            "schema_version": fleetobs.SCHEMA_VERSION, "run_id": run_id,
+            "host": host, "rank": rank, "attempt": attempt,
+            "clock_anchor": {"wall": wall_origin, "monotonic": 0.0},
+        },
+        "displayTimeUnit": "ms",
+        "traceEvents": [
+            {"name": n, "cat": "span", "ph": "X", "ts": t, "dur": d,
+             "pid": 0, "tid": 1} for n, t, d in spans
+        ],
+    }
+
+
+def _write_fleet_dir(tmp_path, *, torn_rank=None, second_run_id=None):
+    """2 ranks x 2 attempts. Rank 1's monotonic origin starts 2.5 s of wall
+    later than rank 0's; attempt 2 starts 10 s after attempt 1. Span layout
+    is chosen so the aligned depth-0 spans interleave without overlap within
+    each track. Optionally tears rank ``torn_rank``'s attempt-1 file
+    mid-record, or stamps rank 1 with a different run id."""
+    wall0 = 1000.0
+    for rank, host, skew in ((0, "hostA", 0.0), (1, "hostB", 2.5)):
+        for attempt, t_attempt in ((1, 0.0), (2, 10.0)):
+            # Span starts are in each host's LOCAL monotonic us: the wall
+            # anchor absorbs both the host skew and the attempt offset.
+            spans = [("step", 100, 800), ("step", 1000, 800),
+                     ("input_wait", 1900, 50)]
+            rid = (second_run_id if (second_run_id and rank == 1) else RUN)
+            doc = _trace_doc(host, rank, attempt,
+                             wall0 + skew + t_attempt, spans, run_id=rid)
+            path = os.path.join(tmp_path, f"trace_events.r{rank}.a{attempt}.json")
+            body = json.dumps(doc)
+            if torn_rank == rank and attempt == 1:
+                # Kill mid-final-record: cut inside the last event dict.
+                body = body[:body.rfind("{") + 12]
+            with open(path, "w") as fh:
+                fh.write(body)
+    return wall0
+
+
+# ---------------------------------------------------------------------------
+# Trace merge: clock alignment + torn-tail salvage (satellite c).
+# ---------------------------------------------------------------------------
+
+
+def test_merge_clock_alignment_and_track_groups(tmp_path):
+    """Depth-0 spans from 2 hosts x 2 attempts land on one axis, shifted by
+    each file's wall anchor, and never overlap within a track group."""
+    _write_fleet_dir(str(tmp_path))
+    merged = trace_merge.merge_traces(str(tmp_path))
+    other = merged["otherData"]
+    assert other["run_ids"] == [RUN]
+    assert sorted(other["track_groups"]) == ["hostA/rank0", "hostB/rank1"]
+    assert other["salvaged"] == []
+
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    # Hand-computed alignment: rank 1 attempt 1's first span starts at its
+    # local 100us + 2.5s host skew; attempt 2 adds the 10s attempt offset.
+    b = other["track_groups"]["hostB/rank1"]
+    b_steps = sorted(e["ts"] for e in spans
+                     if e["pid"] == b and e["name"] == "step")
+    assert b_steps == [100 + 2_500_000, 1000 + 2_500_000,
+                       100 + 12_500_000, 1000 + 12_500_000]
+    # Merged depth-0 spans are non-overlapping within each pid.
+    for pid in other["track_groups"].values():
+        xs = sorted((e["ts"], e["dur"]) for e in spans if e["pid"] == pid)
+        for (t0, d0), (t1, _) in zip(xs, xs[1:]):
+            assert t0 + d0 <= t1, f"overlap in pid {pid}"
+    # Attempt-2 events are badged so restarts are visually attributable.
+    assert any(e.get("args", {}).get("attempt") == 2 for e in spans)
+
+
+def test_merge_salvages_torn_tail(tmp_path):
+    """A file truncated mid-record (killed host) still contributes its header
+    and every complete event — the elastic read_dead_hosts spirit."""
+    _write_fleet_dir(str(tmp_path), torn_rank=1)
+    merged = trace_merge.merge_traces(str(tmp_path))
+    assert merged["otherData"]["salvaged"] == ["r1.a1"]
+    # The torn file keeps at least its first complete span; the run id from
+    # its otherData header survives (no mixed-run false positive).
+    assert merged["otherData"]["run_ids"] == [RUN]
+    b = merged["otherData"]["track_groups"]["hostB/rank1"]
+    torn_spans = [e for e in merged["traceEvents"]
+                  if e.get("ph") == "X" and e["pid"] == b
+                  and "attempt" not in e.get("args", {})]
+    assert 1 <= len(torn_spans) < 3
+
+
+def test_merge_refuses_mixed_runs(tmp_path):
+    _write_fleet_dir(str(tmp_path), second_run_id="run-other")
+    with pytest.raises(SystemExit):
+        trace_merge.merge_traces(str(tmp_path))
+    merged = trace_merge.merge_traces(str(tmp_path), allow_mixed_run=True)
+    assert sorted(merged["otherData"]["run_ids"]) == [RUN, "run-other"]
+
+
+def test_merge_cli_writes_all_artifacts(tmp_path):
+    _write_fleet_dir(str(tmp_path))
+    # Goodput + steprows alongside the traces so the CLI exercises all three.
+    for rank in (0, 1):
+        fleetobs.write_json_atomic(
+            os.path.join(str(tmp_path), f"goodput.r{rank}.a2.json"),
+            {"run_id": RUN, "wall_s": 20.0, "attempts": 2,
+             "categories_s": {"step": 16.0, "restart": 2.0},
+             "goodput_fraction": 0.8, "coverage": 0.9,
+             "meta": {"host": f"host{rank}"}})
+        w = fleetobs.StepRowWriter(str(tmp_path), rank, 1)
+        for s in range(4):
+            w.add({"step": s, "total_s": 0.1, "input_wait_s": 0.0,
+                   "compute_s": 0.1, "checkpoint_s": 0.0})
+        w.flush()
+    assert trace_merge.main([str(tmp_path)]) == 0
+    merged = json.load(open(os.path.join(str(tmp_path), "merged_trace.json")))
+    assert len(merged["otherData"]["track_groups"]) == 2
+    fleet = json.load(open(os.path.join(str(tmp_path), "fleet_goodput.json")))
+    assert fleet["ranks"] == [0, 1] and fleet["attempts"] == 2
+    # Mean of identical per-rank decompositions == the decomposition;
+    # coverage recomputed from it: (16 + 2) / 20.
+    assert fleet["coverage"] == pytest.approx(0.9)
+    assert fleet["goodput_fraction"] == pytest.approx(0.8)
+    assert os.path.exists(os.path.join(str(tmp_path), "straggler.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Straggler attribution.
+# ---------------------------------------------------------------------------
+
+
+def _rows(rank, stall_step=None, stall_s=1.0, n=8, base=0.1):
+    rows = []
+    for s in range(n):
+        iw = stall_s if s == stall_step else 0.005
+        rows.append({"step": s, "total_s": base + (iw - 0.005),
+                     "input_wait_s": iw, "compute_s": base - 0.005,
+                     "checkpoint_s": 0.0})
+    return rows
+
+
+def test_detect_stragglers_attributes_input_wait():
+    """Collectives equalize totals; the stalled rank is found via its
+    host-local input_wait excess, not the (identical) total."""
+    rows0 = _rows(0)
+    rows1 = _rows(1, stall_step=5)
+    # Gang effect: rank 0's total at the stall step matches rank 1's.
+    rows0[5]["total_s"] = rows1[5]["total_s"]
+    out = fleetobs.detect_stragglers({0: rows0, 1: rows1})
+    flagged = [r for r in out if r["flagged"]]
+    assert len(flagged) == 1
+    row = flagged[0]
+    assert row["step"] == 5 and row["slowest_rank"] == 1
+    assert row["cause"] == "input_wait_s"
+    assert row["attribution"]["input_wait_s"] == pytest.approx(0.995)
+
+
+def test_detect_stragglers_quiet_on_balanced_fleet():
+    out = fleetobs.detect_stragglers({0: _rows(0), 1: _rows(1)})
+    assert out and not any(r["flagged"] for r in out)
+
+
+def test_detect_stragglers_total_fallback_device_skew():
+    """No local component elevated -> genuine device skew: slowest total."""
+    rows0, rows1 = _rows(0), _rows(1)
+    rows1[3]["total_s"] = 0.5  # slower step, flat input_wait/checkpoint
+    rows1[3]["compute_s"] = 0.5 - rows1[3]["input_wait_s"]
+    out = {r["step"]: r for r in fleetobs.detect_stragglers(
+        {0: rows0, 1: rows1})}
+    assert out[3]["flagged"] and out[3]["slowest_rank"] == 1
+    assert out[3]["cause"] == "compute_s"
+
+
+def test_straggler_monitor_warns_and_keeps_baseline():
+    mon = fleetobs.StragglerMonitor(threshold=2.0, min_window=3)
+    for s in range(5):
+        assert mon.observe(s, total_s=0.1, input_wait_s=0.005) is None
+    reason = mon.observe(5, total_s=1.1, input_wait_s=1.0)
+    assert reason is not None and "input_wait" in reason
+    # The stall was recorded after the check: the next normal step is clean.
+    assert mon.observe(6, total_s=0.1, input_wait_s=0.005) is None
+    assert mon.warnings == 1
+
+
+# ---------------------------------------------------------------------------
+# Step rows: buffered writes, attempt override, torn tolerance.
+# ---------------------------------------------------------------------------
+
+
+def test_steprows_later_attempt_overrides_replayed_steps(tmp_path):
+    w1 = fleetobs.StepRowWriter(str(tmp_path), 0, 1)
+    for s in range(4):
+        w1.add({"step": s, "total_s": 0.1})
+    w1.flush()
+    w2 = fleetobs.StepRowWriter(str(tmp_path), 0, 2)  # resume replays 2..3
+    for s in (2, 3, 4):
+        w2.add({"step": s, "total_s": 0.2})
+    w2.flush()
+    rows = fleetobs.load_steprows(str(tmp_path))[0]
+    assert [r["step"] for r in rows] == [0, 1, 2, 3, 4]
+    assert rows[2]["total_s"] == 0.2 and rows[0]["total_s"] == 0.1
+
+
+def test_steprows_torn_tail_skipped(tmp_path):
+    w = fleetobs.StepRowWriter(str(tmp_path), 0, 1)
+    for s in range(3):
+        w.add({"step": s, "total_s": 0.1})
+    w.flush()
+    with open(w.path, "a") as fh:
+        fh.write('{"step": 3, "total_s"')  # killed mid-append
+    rows = fleetobs.load_steprows(str(tmp_path))[0]
+    assert [r["step"] for r in rows] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = fleetobs.FlightRecorder(capacity=4)
+    for s in range(10):
+        rec.record_timing(s, total_s=0.1)
+    rec.record_health(9, {"loss": 1.5, "arr": [1, 2]})  # arr: non-scalar out
+    assert len(rec) == 4
+    assert [r["step"] for r in rec.rows()] == [6, 7, 8, 9]
+    assert rec.rows()[-1]["loss"] == 1.5 and "arr" not in rec.rows()[-1]
+
+    p1 = rec.dump(str(tmp_path), reason="anomaly", meta={"step": 9})
+    p2 = rec.dump(str(tmp_path), reason="preempt")  # append, not clobber
+    assert p1 == p2
+    lines = [json.loads(ln) for ln in open(p1)]
+    headers = [ln for ln in lines if "flightrec" in ln]
+    assert [h["flightrec"] for h in headers] == ["anomaly", "preempt"]
+    assert headers[0]["records"] == 4
+    assert len(lines) == 2 + 8
+
+
+def test_dump_active_registry(tmp_path):
+    rec = fleetobs.FlightRecorder(capacity=8)
+    rec.record_timing(3, total_s=0.1)
+    fleetobs.set_active(rec, str(tmp_path), rank=1, meta={"run_id": RUN})
+    try:
+        path = fleetobs.dump_active("host_loss", step=3)
+        assert path and path.endswith("flightrec.r1.jsonl")
+        header = json.loads(open(path).readline())
+        assert header["flightrec"] == "host_loss"
+        assert header["run_id"] == RUN and header["step"] == 3
+    finally:
+        fleetobs.set_active(None)
+    assert fleetobs.dump_active("host_loss") is None
+
+
+# ---------------------------------------------------------------------------
+# Artifact identity + progress.
+# ---------------------------------------------------------------------------
+
+
+def test_ensure_run_id_stable_across_attempts_fresh_replaces(tmp_path):
+    d = str(tmp_path)
+    rid = fleetobs.ensure_run_id(d, "attempt-1", fresh=True, rank=0)
+    assert rid == "attempt-1"
+    # Resumed attempt keeps the original id; a rank>0 reads the same.
+    assert fleetobs.ensure_run_id(d, "attempt-2", fresh=False, rank=0) == rid
+    assert fleetobs.ensure_run_id(d, "attempt-2", rank=1) == rid
+    # A fresh run replaces the stale id from the previous experiment.
+    assert fleetobs.ensure_run_id(d, "new-run", fresh=True, rank=0) == "new-run"
+
+
+def test_ensure_run_id_rank_nonzero_never_creates(tmp_path):
+    d = str(tmp_path)
+    rid = fleetobs.ensure_run_id(d, "r1-fallback", rank=1, timeout_s=0.2)
+    assert rid == "r1-fallback"
+    assert not os.path.exists(os.path.join(d, fleetobs.RUN_ID_FILE))
+
+
+def test_write_progress_atomic_and_stamped(tmp_path):
+    path = fleetobs.write_progress(str(tmp_path), {"step": 7, "loss": 2.0})
+    data = json.load(open(path))
+    assert data["step"] == 7
+    assert data["schema_version"] == fleetobs.SCHEMA_VERSION
+    assert not [n for n in os.listdir(str(tmp_path)) if ".tmp." in n]
+
+
+def test_check_regression_goodput_rejects_mixed_run(tmp_path):
+    import check_regression as cr
+
+    path = os.path.join(str(tmp_path), "fleet_goodput.json")
+    base = {"wall_s": 10.0, "coverage": 0.99,
+            "categories_s": {"step": 9.9}, "attempts": 1}
+    fleetobs.write_json_atomic(path, {**base, "run_ids": [RUN, "run-other"]})
+    failures, report = cr.check_goodput(path)
+    assert failures and any("MIXED-RUN" in ln for ln in report)
+    fleetobs.write_json_atomic(path, {**base, "run_ids": [RUN]})
+    failures, _ = cr.check_goodput(path)
+    assert not failures
+
+
+# ---------------------------------------------------------------------------
+# Live metrics surface.
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_prometheus_and_progress():
+    srv = fleetobs.MetricsServer(port=0, addr="127.0.0.1").start()
+    try:
+        srv.update(step=42, loss=1.25, bad=float("nan"),
+                   run_id=RUN, skipped_none=None, flag=True)
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        text = body.decode()
+        assert "pdtx_step 42.0" in text and "pdtx_loss 1.25" in text
+        assert "pdtx_bad NaN" in text  # Prometheus non-finite spelling
+        assert f'run_id="{RUN}"' in text  # info labels, not a gauge
+        assert "skipped_none" not in text and "flag" not in text
+        prog = json.loads(urllib.request.urlopen(
+            f"{base}/progress", timeout=5).read())
+        assert prog["step"] == 42.0 and prog["run_id"] == RUN
+        err = urllib.request.urlopen  # 404 on unknown paths
+        with pytest.raises(Exception):
+            err(f"{base}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos spec rank qualifier (the straggler drill's targeting mechanism).
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_spec_rank_qualifier():
+    evs = chaos_lib.parse_spec("loader_stall@batch=5:rank=1,sigterm@step=9")
+    assert evs[0].rank == 1 and evs[0].value == 5
+    assert evs[1].rank is None
+    with pytest.raises(ValueError):
+        chaos_lib.parse_spec("loader_stall@batch=5:rank=x")
+    with pytest.raises(ValueError):
+        chaos_lib.parse_spec("loader_stall@batch=5:frank=1")
+
+
+def test_chaos_rank_qualifier_gates_firing(monkeypatch, tmp_path):
+    monkeypatch.setenv("PROCESS_ID", "0")
+    eng = chaos_lib.ChaosEngine("loader_stall@batch=2:rank=1",
+                                log_dir=str(tmp_path))
+    eng.STALL_S = 0.01
+    batch = {"x": [0.0]}
+    assert eng.batch_hook(0, 2, batch) is batch  # rank 0: no fire
+    assert not eng.events[0].fired
+    eng2 = chaos_lib.ChaosEngine("loader_stall@batch=2:rank=1",
+                                 log_dir=str(tmp_path), rank=1)
+    eng2.STALL_S = 0.01
+    eng2.batch_hook(0, 2, batch)
+    assert eng2.events[0].fired

@@ -235,6 +235,23 @@ def test_anomaly_guard_continue_and_scaler_skip(tmp_path):
         telemetry_lib.AnomalyGuard(str(tmp_path), action="explode")
 
 
+def test_anomaly_guard_flight_dump_once_per_episode(tmp_path):
+    guard = telemetry_lib.AnomalyGuard(str(tmp_path), action="continue")
+    dumps = []
+    guard.flight_dump_fn = lambda reason, **kw: dumps.append(
+        (reason, kw["step"]))
+    # A NaN that sticks in the params flags every subsequent check — the
+    # bundle is per-step, but the flight ring dumps once per episode.
+    for s in (4, 5, 6):
+        assert guard.check(s, {"loss": float("nan")}) is True
+    assert dumps == [("anomaly", 4)]
+    assert (tmp_path / "anomaly_step00000006.json").exists()
+    # A clean row closes the episode; the next trip dumps again.
+    assert guard.check(7, {"loss": 1.0}) is False
+    assert guard.check(8, {"loss": float("inf")}) is True
+    assert dumps == [("anomaly", 4), ("anomaly", 8)]
+
+
 def test_telemetry_facade_observe_snapshot_emit(tmp_path):
     tele = telemetry_lib.Telemetry(str(tmp_path), run_id="rid",
                                    anomaly_action="continue")
