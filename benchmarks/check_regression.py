@@ -208,6 +208,39 @@ def record_aot_golden(result: dict, path: str = GOLDEN_PATH) -> str:
     return aot_key(result)
 
 
+def check_lint(root=None, baseline=None, ir_model=None):
+    """Run graftlint (AST layer; optionally one IR lowering) as a gate.
+
+    Fails on any unbaselined error-severity finding; stale suppressions are
+    reported but do not fail (the code they covered moved — refresh with
+    ``--record``).
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import graftlint
+
+    findings = graftlint.run_ast(root or graftlint.REPO_ROOT)
+    if ir_model:
+        findings += graftlint.run_ir(ir_model)
+    doc = graftlint.load_baseline(baseline or graftlint.DEFAULT_BASELINE)
+    unbaselined, baselined, stale = graftlint.split_findings(findings, doc)
+    failures, report = [], []
+    for f in findings:
+        if f in baselined:
+            report.append(f"LINT-BASELINED {f.render()}")
+        elif f.severity == graftlint.ERROR:
+            failures.append(f.render())
+            report.append(f"LINT-FAIL {f.render()}")
+        else:
+            report.append(f"LINT-INFO {f.render()}")
+    for s in stale:
+        report.append(f"LINT-STALE suppression no longer matches: "
+                      f"{s.get('rule')} {s.get('path')} {s.get('scope')}")
+    report.append(f"LINT {len(findings)} finding(s), {len(baselined)} "
+                  f"baselined, {len(failures)} unbaselined error(s), "
+                  f"{len(stale)} stale")
+    return failures, report, findings
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("result", nargs="?", help="bench JSON file (default: stdin)")
@@ -228,9 +261,40 @@ def main(argv=None):
                         "without a chip")
     p.add_argument("--record", action="store_true",
                    help="with --aot-bytes: write the report's regions as "
-                        "the golden entry instead of comparing")
+                        "the golden entry instead of comparing; with "
+                        "--lint: refresh the suppression baseline (new "
+                        "entries land as UNREVIEWED)")
+    p.add_argument("--lint", action="store_true",
+                   help="run graftlint (AST layer) as a gate: fail on any "
+                        "unbaselined error finding; chip-free and jax-free")
+    p.add_argument("--lint-ir", default=None, metavar="MODEL",
+                   help="with --lint: also IR-lint MODEL's abstract "
+                        "lowering (donation/precision/host-transfer/"
+                        "sharding rules; needs jax)")
+    p.add_argument("--lint-root", default=None,
+                   help="with --lint: lint this tree instead of the repo "
+                        "(fixture testing)")
+    p.add_argument("--lint-baseline", default=None,
+                   help="with --lint: suppression file (default "
+                        "benchmarks/lint_baseline.json)")
     args = p.parse_args(argv)
     failures, report = [], []
+    if args.lint:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        l_failures, l_report, findings = check_lint(
+            args.lint_root, args.lint_baseline, args.lint_ir)
+        if args.record:
+            import graftlint
+
+            graftlint.record_baseline(
+                findings, args.lint_baseline or graftlint.DEFAULT_BASELINE)
+            print("RECORDED lint baseline "
+                  f"({sum(1 for f in findings if f.severity == graftlint.ERROR)} "
+                  "suppression(s); review any UNREVIEWED entries)")
+            return 0
+        for line in l_report:
+            print(line)
+        return 1 if l_failures else 0
     if args.aot_bytes:
         raw = open(args.result).read() if args.result else sys.stdin.read()
         try:

@@ -522,34 +522,27 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
     return out
 
 
-def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
-               seq_len=2048, strategy=None, remat=False,
-               remat_policy="nothing", attn_impl="auto",
-               moe_capacity_factor=1.0, moe_top_k=2,
-               moe_dispatch_impl="gather", moe_combine_dtype="fp32",
-               moe_router_dtype="fp32", moe_router_impl="reference"):
-    """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
+def build_abstract_step(model_name: str, *, per_chip_batch=4,
+                        precision="bf16", seq_len=2048, strategy=None,
+                        remat=False, remat_policy="nothing",
+                        attn_impl="auto", moe_capacity_factor=1.0,
+                        moe_top_k=2, moe_dispatch_impl="gather",
+                        moe_combine_dtype="fp32", moe_router_dtype="fp32",
+                        moe_router_impl="reference"):
+    """Chipless abstract train step: the shared lowering front-end.
 
-    AOT-lowers the SAME train step bench.py times — same registry model,
-    optimizer, strategy resolution as ``bench.setup_step`` — but with
-    ABSTRACT inputs (``jax.eval_shape``; no params materialized), then
-    classifies every instruction of the compiled module by its moe
-    named-scope tag and tabulates static program facts per region: op
-    counts, modeled HBM bytes (``build_op_bytes``), and the HLO category
-    mix. No timing. The fusion/schedule is THIS process' XLA backend (on a
-    CPU host: XLA:CPU) — op counts and logical bytes are facts of the
-    lowered program, but TPU fusion differs, so downstream consumers must
-    label these numbers derived, not measured.
+    Builds the SAME program ``bench.setup_step`` times — same registry
+    model, optimizer, strategy resolution — but with ABSTRACT inputs
+    (``jax.eval_shape``; no params materialized), so callers can
+    ``step.lower(abstract_state, abstract_batch)`` under ``mesh`` without a
+    chip. Consumers: ``aot_report`` (per-region byte model, the
+    ``--aot-bytes`` gate) and ``graftlint`` IR rules (donation / precision /
+    host-transfer / sharding checks on the identical program).
 
-    Region BYTES use proportional attribution (``build_op_moe_weights``):
-    a mixed fusion's traffic is split across regions by interior-line
-    result bytes instead of winner-take-all line majority, which on
-    XLA:CPU charged whole-block backward mega-fusions to whichever MoE
-    region tagged a few cotangent lines (see the r8 PROFILE_MOE.md
-    addendum). Integer op counts and the category mix still use the
-    majority map — an instruction is one op in one region. The output
-    carries ``"attribution": "proportional_bytes"`` so byte goldens
-    recorded under one model never compare against the other."""
+    Returns a dict with ``step`` (jitted, ``donate_argnums=0``),
+    ``abstract_state``, ``abstract_batch``, ``mesh``, ``strategy``, and the
+    resolved precision ``policy``.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -607,8 +600,62 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     }
     step = jax.jit(train_loop.make_train_step(
         train_loop.get_task(bundle.task)), donate_argnums=0)
-    with mesh_lib.use_mesh(mesh):
-        compiled = step.lower(abstract_state, abstract_batch).compile()
+    return {
+        "step": step,
+        "abstract_state": abstract_state,
+        "abstract_batch": abstract_batch,
+        "mesh": mesh,
+        "strategy": strategy,
+        "policy": policy,
+    }
+
+
+def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
+               seq_len=2048, strategy=None, remat=False,
+               remat_policy="nothing", attn_impl="auto",
+               moe_capacity_factor=1.0, moe_top_k=2,
+               moe_dispatch_impl="gather", moe_combine_dtype="fp32",
+               moe_router_dtype="fp32", moe_router_impl="reference"):
+    """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
+
+    AOT-lowers the SAME train step bench.py times — same registry model,
+    optimizer, strategy resolution as ``bench.setup_step`` — but with
+    ABSTRACT inputs (``jax.eval_shape``; no params materialized), then
+    classifies every instruction of the compiled module by its moe
+    named-scope tag and tabulates static program facts per region: op
+    counts, modeled HBM bytes (``build_op_bytes``), and the HLO category
+    mix. No timing. The fusion/schedule is THIS process' XLA backend (on a
+    CPU host: XLA:CPU) — op counts and logical bytes are facts of the
+    lowered program, but TPU fusion differs, so downstream consumers must
+    label these numbers derived, not measured.
+
+    Region BYTES use proportional attribution (``build_op_moe_weights``):
+    a mixed fusion's traffic is split across regions by interior-line
+    result bytes instead of winner-take-all line majority, which on
+    XLA:CPU charged whole-block backward mega-fusions to whichever MoE
+    region tagged a few cotangent lines (see the r8 PROFILE_MOE.md
+    addendum). Integer op counts and the category mix still use the
+    majority map — an instruction is one op in one region. The output
+    carries ``"attribution": "proportional_bytes"`` so byte goldens
+    recorded under one model never compare against the other."""
+    built = build_abstract_step(
+        model_name, per_chip_batch=per_chip_batch, precision=precision,
+        seq_len=seq_len, strategy=strategy, remat=remat,
+        remat_policy=remat_policy, attn_impl=attn_impl,
+        moe_capacity_factor=moe_capacity_factor, moe_top_k=moe_top_k,
+        moe_dispatch_impl=moe_dispatch_impl,
+        moe_combine_dtype=moe_combine_dtype,
+        moe_router_dtype=moe_router_dtype,
+        moe_router_impl=moe_router_impl)
+    import jax
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib)
+
+    strategy = built["strategy"]
+    with mesh_lib.use_mesh(built["mesh"]):
+        compiled = built["step"].lower(
+            built["abstract_state"], built["abstract_batch"]).compile()
     hlo_text = compiled.as_text()
     op_cat, _ = build_op_categories(hlo_text)
     op_bytes = build_op_bytes(hlo_text)

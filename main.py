@@ -169,6 +169,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-inject", default=None,
                    help="'rank:step' — hard-kill that process before the "
                         "given global step (recovery testing)")
+    p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--warmup-epochs", type=float, default=None,
+                   help="linear LR warmup length (fractions allowed)")
+    p.add_argument("--momentum", type=float, default=None,
+                   help="SGD momentum")
+    p.add_argument("--label-smoothing", type=float, default=None)
+    p.add_argument("--grad-clip", type=float, default=None,
+                   help="global-norm gradient clip (0 disables)")
+    p.add_argument("--pp-microbatches", type=int, default=None,
+                   dest="pp_microbatches",
+                   help="GPipe microbatches for --strategy pp")
+    p.add_argument("--no-native-loader", action="store_false", default=None,
+                   dest="native_loader",
+                   help="disable the C++ batch engine even when available")
+    p.add_argument("--eval-every-epochs", type=int, default=None)
+    p.add_argument("--checkpoint-every-epochs", type=int, default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="where --profile-steps traces are written")
     p.add_argument("--coordinator", default=None,
                    help="coordinator address host:port (else env)")
     p.add_argument("--num-processes", type=int, default=None)

@@ -64,6 +64,12 @@ class CheckpointCorruptError(RuntimeError):
     """A committed checkpoint failed integrity verification on restore."""
 
 
+def _read_json(path: str):
+    """JSON file read, designed to be invoked via ``retriable_io``."""
+    with open(path) as fh:
+        return json.load(fh)
+
+
 def _file_crc32(path: str) -> int:
     """Streaming CRC32 of a file's bytes (1 MB chunks).
 
@@ -107,7 +113,8 @@ class Checkpointer:
         #: asked for "latest usable", this is which one survived verification.
         self.last_restored_step: int | None = None
         if distributed.is_main_process():
-            os.makedirs(directory, exist_ok=True)
+            resilience.retriable_io(os.makedirs, directory, exist_ok=True,
+                                    _what="ckpt_mkdir")
             self._recover_interrupted_replace()
         if jax.process_count() > 1:
             # Non-main hosts must not race latest_checkpoint() against the
@@ -213,7 +220,7 @@ class Checkpointer:
             shards[path] = regions
             manifest_leaves[path] = {
                 "shape": list(np.shape(arr)),
-                "dtype": str(np.asarray(regions[0][1]).dtype) if regions else str(arr.dtype),
+                "dtype": str(regions[0][1].dtype) if regions else str(arr.dtype),
             }
 
         # Source-topology record (elastic resume): which geometry wrote this
@@ -251,7 +258,8 @@ class Checkpointer:
 
         def write():
             arrays_dir = os.path.join(attempt_dir, "arrays")
-            os.makedirs(arrays_dir, exist_ok=True)
+            resilience.retriable_io(os.makedirs, arrays_dir, exist_ok=True,
+                                    _what="ckpt_write")
             written: dict[str, list] = {}
             for path, regions in shards.items():
                 safe = path.replace("/", ".")
@@ -274,9 +282,13 @@ class Checkpointer:
                 # shared filesystem. No device collective -> async-safe.
                 flist = os.path.join(attempt_dir,
                                      f"files.p{jax.process_index()}.json")
-                with open(flist + ".tmp", "w") as fh:
-                    json.dump({p: f for p, f in written.items()}, fh)
-                os.replace(flist + ".tmp", flist)
+
+                def write_flist():
+                    with open(flist + ".tmp", "w") as fh:
+                        json.dump({p: f for p, f in written.items()}, fh)
+                    os.replace(flist + ".tmp", flist)
+
+                resilience.retriable_io(write_flist, _what="ckpt_write")
             if distributed.is_main_process():
                 if multihost and not self._await_hosts(attempt_dir, nproc):
                     # A host died or stalled mid-save: leave uncommitted,
@@ -313,8 +325,12 @@ class Checkpointer:
                 # any point leaves either the old or the new copy intact
                 # (the one-syscall gap between the two renames is healed by
                 # _recover_interrupted_replace at next startup).
-                with open(os.path.join(attempt_dir, COMMIT_FILE), "w") as fh:
-                    fh.write(str(step))
+                def write_commit():
+                    with open(os.path.join(attempt_dir, COMMIT_FILE),
+                              "w") as fh:
+                        fh.write(str(step))
+
+                resilience.retriable_io(write_commit, _what="ckpt_commit")
                 old_dir = step_dir + OLD_SUFFIX
                 if os.path.isdir(step_dir):
                     if os.path.isdir(old_dir):
@@ -402,7 +418,8 @@ class Checkpointer:
         # here: _prune runs at the end of process 0's write thread, and every
         # host's next save() is gated behind a main-thread barrier that
         # process 0 only reaches after joining this thread.
-        for name in os.listdir(self.directory):
+        for name in resilience.retriable_io(os.listdir, self.directory,
+                                            _what="ckpt_prune"):
             if name.endswith(SAVING_SUFFIX):
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
@@ -470,10 +487,11 @@ class Checkpointer:
         _warn_geometry_mismatch(step, manifest)
         # Union per-host file lists when present (multi-host shared fs).
         leaves = manifest["leaves"]
-        for fn in os.listdir(step_dir):
+        for fn in resilience.retriable_io(os.listdir, step_dir,
+                                          _what="ckpt_read"):
             if fn.startswith("files.p") and fn.endswith(".json"):
-                with open(os.path.join(step_dir, fn)) as fh:
-                    extra_files = json.load(fh)
+                extra_files = resilience.retriable_io(
+                    _read_json, os.path.join(step_dir, fn), _what="ckpt_read")
                 for p, files in extra_files.items():
                     known = {e["file"] for e in leaves[p]["files"]}
                     leaves[p]["files"] += [e for e in files if e["file"] not in known]
@@ -554,7 +572,9 @@ def _assemble_full(arrays_dir: str, meta: dict) -> np.ndarray:
     """Materialize a whole leaf (host-local numpy targets only)."""
     full = np.empty(meta["shape"], dtype=np.dtype(meta["dtype"]))
     for entry in meta["files"]:
-        region = np.load(os.path.join(arrays_dir, entry["file"]))
+        region = resilience.retriable_io(
+            np.load, os.path.join(arrays_dir, entry["file"]),
+            _what="ckpt_read")
         if full.ndim == 0:
             full = region.reshape(())
         else:
@@ -577,8 +597,9 @@ def _assemble_sharded(arrays_dir: str, meta: dict, sharding) -> jax.Array:
 
     def region(fname):
         if fname not in opened:
-            opened[fname] = np.load(os.path.join(arrays_dir, fname),
-                                    mmap_mode="r")
+            opened[fname] = resilience.retriable_io(
+                np.load, os.path.join(arrays_dir, fname), mmap_mode="r",
+                _what="ckpt_read")
         return opened[fname]
 
     def assemble(bounds):

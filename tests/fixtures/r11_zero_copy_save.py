@@ -1,0 +1,41 @@
+"""Regression fixture: the r11 async-checkpoint corruption, pre-fix shape.
+
+This module reproduces ``Checkpointer.save`` as it looked BEFORE the r11
+fix: per-shard snapshots taken with ``np.asarray`` (a zero-copy view of the
+device buffer) and handed to the background write thread. The caller then
+donates the state to the next train step, XLA recycles the buffer memory
+for activations, and the thread serializes garbage — with a valid CRC,
+since the checksum is computed over whatever bytes hit disk.
+
+Never imported by the package. tests/test_graftlint.py lints this file and
+asserts GL001 flags the ``np.asarray`` snapshot; the fixed code
+(``np.array`` copies) must come back clean.
+"""
+import os
+import threading
+
+import numpy as np
+
+
+class BrokenCheckpointer:
+    """Pre-r11 save(): zero-copy shard snapshots escape into the writer."""
+
+    def save(self, state, step, directory):
+        shards = {}
+        for path, arr in state.items():
+            regions = []
+            for sh in arr.addressable_shards:
+                # BUG (r11): np.asarray aliases the device buffer; once the
+                # caller donates the state this memory is recycled under
+                # the background thread mid-write.
+                regions.append((list(sh.index), np.asarray(sh.data)))
+            shards[path] = regions
+
+        def write():
+            for path, regions in shards.items():
+                for i, (idx, data) in enumerate(regions):
+                    np.save(os.path.join(directory, f"{path}.{i}.npy"), data)
+
+        t = threading.Thread(target=write, daemon=True)
+        t.start()
+        return t
