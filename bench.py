@@ -488,7 +488,8 @@ def main(argv=None):
     p.add_argument("--moe-top-k", type=int, default=2,
                    help="experts routed per token (llama_moe family)")
     p.add_argument("--moe-dispatch", default="gather",
-                   choices=["sort", "gather", "einsum"], dest="moe_dispatch",
+                   choices=["sort", "gather", "einsum", "dropless"],
+                   dest="moe_dispatch",
                    help="MoE dispatch formulation (parallel/moe.py)")
     p.add_argument("--moe-router-dtype", default="fp32",
                    choices=["fp32", "bf16"], dest="moe_router_dtype",

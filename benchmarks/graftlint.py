@@ -67,7 +67,11 @@ INFO = "info"
 
 # Region tags used by the AOT byte gate; the IR layer keys GL102/GL104 on
 # the same vocabulary so findings line up with check_regression --aot-bytes.
-MOE_TAG_RE = re.compile(r"\bmoe_(router|dispatch|experts|combine|aux)\b")
+# moe_experts_gmm is the dropless grouped-matmul kernel's inner scope
+# (ops/grouped_matmul.py) — listed before moe_experts so a standalone
+# occurrence classifies; nested occurrences resolve to the outer tag.
+MOE_TAG_RE = re.compile(
+    r"\bmoe_(router|dispatch|experts_gmm|experts|combine|aux)\b")
 
 
 def _norm(s: str) -> str:

@@ -1,15 +1,23 @@
 #!/usr/bin/env python
-"""MoE dispatch microbenchmark: gather vs einsum at real token counts
-(VERDICT r2 #8).
+"""MoE dispatch microbenchmark: gather vs einsum vs dropless at real
+token counts (VERDICT r2 #8; dropless added r14).
 
 Times one MoE block — router + dispatch + stacked-expert FFN + combine —
 fwd+bwd at GPT-2-scale dims (d=768, ffn=3072, E=8, top-2) across token
-counts, for both dispatch implementations (``parallel/moe.py``). The
-einsum path's O(T*E*C) dispatch mask is the thing being measured against
-the gather path's O(E*C*d + T*k) slot table.
+counts, for the dispatch implementations in ``parallel/moe.py``. The
+einsum path's O(T*E*C) dispatch mask is measured against the gather
+path's O(E*C*d + T*k) slot table and the dropless path's ragged grouped
+matmul (ops/grouped_matmul.py — no capacity buffer at all).
 
 Slope-timed (two scan trip counts — cancels the ~75 ms fixed dispatch
 cost of the tunnel; see BENCH_FLASH_MICRO.json).
+
+A second, chipless section reports the AOT routed-region byte model per
+impl at the llama_moe bench shape (b4 s2048) via profile_step.aot_report
+— the same numbers check_regression.py --aot-bytes gates. "Routed-region
+bytes" = the sum over the moe_* named-scope regions of one train step
+(everything inside the MoE block: router + dispatch + experts + combine
++ aux), as opposed to the dense trunk (non_moe).
 
     python benchmarks/moe_bench.py [--out BENCH_MOE.json]
 """
@@ -80,16 +88,42 @@ def bench_point(T, impl):
             "expert_tflops": round(flops / sec / 1e12, 1)}
 
 
+def aot_bytes_rows(impls):
+    """Routed-region AOT byte model per dispatch impl at the llama_moe
+    bench shape — chipless, so it runs (and means the same thing) on the
+    CI host and next to the chip timings."""
+    from benchmarks import profile_step
+
+    rows = []
+    for impl in impls:
+        r = profile_step.aot_report("llama_moe", per_chip_batch=4,
+                                    seq_len=2048, moe_dispatch_impl=impl)
+        regions = {tag: row["gbytes_modeled"]
+                   for tag, row in r["regions"].items()}
+        rows.append({
+            "dispatch": impl,
+            "routed_gb": round(sum(v for tag, v in regions.items()
+                                   if tag.startswith("moe_")), 3),
+            "regions_gb": regions,
+            "xla_flops_per_step": r["xla_flops_per_step"],
+        })
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="BENCH_MOE.json")
     p.add_argument("--tokens", default="4096,16384,65536")
+    p.add_argument("--aot-impls", default="gather,sort,dropless",
+                   help="dispatch impls for the routed-region AOT byte "
+                        "section (empty string skips it)")
     args = p.parse_args(argv)
     import jax
 
     rows = []
     for T in [int(x) for x in args.tokens.split(",")]:
-        for impl in ("gather", "einsum"):
+        for impl in ("gather", "einsum", "dropless"):
             try:
                 rows.append(bench_point(T, impl))
             except Exception as e:
@@ -98,14 +132,21 @@ def main(argv=None):
                              "error": ("OOM" if "RESOURCE_EXHAUSTED" in msg
                                        else msg[:200])})
             print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    aot_impls = [s for s in args.aot_impls.split(",") if s]
     out = {
-        "bench": "moe_dispatch_gather_vs_einsum",
+        "bench": "moe_dispatch_gather_vs_einsum_vs_dropless",
         "device": jax.devices()[0].device_kind,
         "dims": {"d_model": D_MODEL, "ffn": FFN, "experts": EXPERTS,
                  "top_k": 2, "capacity_factor": 1.25},
         "pass": "fwd+bwd (params and input grads)",
         "timing": "two-trip-count slope, chained scan, best of 3 per point",
         "rows": rows,
+        "aot_routed_bytes": {
+            "model": "llama_moe", "per_chip_batch": 4, "seq_len": 2048,
+            "note": "chipless profile_step.aot_report; routed_gb = sum "
+                    "of moe_* region modeled bytes",
+            "rows": aot_bytes_rows(aot_impls),
+        } if aot_impls else None,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)

@@ -117,8 +117,13 @@ def build_op_categories(hlo_text: str):
 # MoE step regions tagged with jax.named_scope in parallel/moe.py. The tag
 # survives into op_name metadata for forward ops ("...moe_dispatch/...") and
 # for their cotangents (jax keeps the scope path inside transpose(...)), so
-# a rollup by tag attributes fwd+bwd time per region.
-_MOE_TAG_RE = re.compile(r"\bmoe_(router|dispatch|experts|combine|aux)\b")
+# a rollup by tag attributes fwd+bwd time per region. The dropless kernel
+# (ops/grouped_matmul.py) tags its pallas calls moe_experts_gmm; nested
+# under moe_experts the leftmost match wins (bytes stay comparable across
+# dispatch impls), while kernel ops whose scope stack XLA rewrote down to
+# the inner tag still classify instead of leaking into non_moe.
+_MOE_TAG_RE = re.compile(
+    r"\bmoe_(router|dispatch|experts_gmm|experts|combine|aux)\b")
 
 
 def _moe_tag(line: str) -> str | None:
@@ -232,6 +237,37 @@ def build_op_moe_weights(hlo_text: str):
             if t:
                 op_w[op] = {t: 1.0}
     return op_w
+
+
+# Interpret-mode Pallas emulation: off-TPU, pallas_call lowers to an XLA
+# while loop that walks the kernel grid, materializing every VMEM block
+# move as a full-array dynamic-slice / dynamic-update-slice per grid step.
+# On the real target the kernel is ONE custom call whose HBM traffic is
+# its operands + results; the loop interior is pure CPU-lowering artifact
+# (r14: it charged ~103 GB of phantom traffic to moe_experts for the
+# dropless grouped matmul at the llama_moe bench shape). Interior ops
+# carry the kernel's named scope followed by the loop path in op_name
+# ("...moe_experts_gmm/while/body/..."); the while instruction itself
+# (scope path ends at .../while) is KEPT — its carried tuple is the
+# operand+result boundary, i.e. what a real custom call would be charged.
+# Deliberately scoped to the dropless grouped-matmul kernel tag so rows
+# recorded for non-Pallas impls are byte-identical under this rule.
+_PALLAS_INTERIOR_RE = re.compile(r"\bmoe_experts_gmm/while/")
+
+
+def build_pallas_interior(hlo_text: str):
+    """Instruction names interior to an interpret-mode Pallas grid loop
+    (``_PALLAS_INTERIOR_RE`` on op_name). ``aot_report`` drops them from
+    the byte/op tabulation entirely — they do not exist on the target."""
+    interior = set()
+    for line in hlo_text.splitlines():
+        m = re.match(r"\s+(?:ROOT )?%?([\w.\-]+) = ", line)
+        if not m:
+            continue
+        nm = re.search(r'op_name="([^"]+)"', line)
+        if nm and _PALLAS_INTERIOR_RE.search(nm.group(1)):
+            interior.add(m.group(1))
+    return interior
 
 
 _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
@@ -637,7 +673,13 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     addendum). Integer op counts and the category mix still use the
     majority map — an instruction is one op in one region. The output
     carries ``"attribution": "proportional_bytes"`` so byte goldens
-    recorded under one model never compare against the other."""
+    recorded under one model never compare against the other.
+
+    Pallas-kernel interior ops from the off-TPU interpret lowering are
+    excluded wholesale (``build_pallas_interior``): the grid while-loop
+    that emulates the kernel on CPU is not part of the target program,
+    and the kernel's real HBM charge — operands + results, as for any
+    custom call — is carried by the while instruction's boundary tuple."""
     built = build_abstract_step(
         model_name, per_chip_batch=per_chip_batch, precision=precision,
         seq_len=seq_len, strategy=strategy, remat=remat,
@@ -661,6 +703,10 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     op_bytes = build_op_bytes(hlo_text)
     op_moe = build_op_moe_tags(hlo_text)
     op_w = build_op_moe_weights(hlo_text)
+    # Off-TPU lowering emulates Pallas kernels as grid while-loops; their
+    # interior ops are not target-program ops and would charge phantom
+    # full-array traffic per grid step (see _PALLAS_INTERIOR_RE).
+    op_interior = build_pallas_interior(hlo_text)
 
     regions: dict[str, dict] = {}
 
@@ -669,6 +715,8 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
                                         "by_category": collections.Counter()})
 
     for op, b in op_bytes.items():
+        if op in op_interior:
+            continue
         assigned = 0.0
         for tag, frac in op_w.get(op, {}).items():
             row(tag)["gbytes_modeled"] += b * frac / 1e9
@@ -724,7 +772,7 @@ def main(argv=None):
     p.add_argument("--attn-impl", default="auto")
     p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--moe-dispatch", default="gather",
-                   choices=["sort", "gather", "einsum"])
+                   choices=["sort", "gather", "einsum", "dropless"])
     p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"])
     p.add_argument("--moe-router-dtype", default="fp32",
                    choices=["fp32", "bf16"])

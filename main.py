@@ -70,10 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expert capacity = cf * T * top_k / E (tokens beyond "
                         "it are dropped, Switch-style)")
     p.add_argument("--moe-dispatch", default=None, dest="moe_dispatch_impl",
-                   choices=["sort", "gather", "einsum"],
+                   choices=["sort", "gather", "einsum", "dropless"],
                    help="MoE token-dispatch formulation (parallel/moe.py): "
-                        "sort (argsort+segment), gather (slot table), or "
-                        "einsum (one-hot masks, GSPMD oracle)")
+                        "sort (argsort+segment), gather (slot table), "
+                        "einsum (one-hot masks, GSPMD oracle), or dropless "
+                        "(ragged Pallas grouped matmul — no capacity "
+                        "factor, no dropped tokens)")
     p.add_argument("--moe-combine", default=None, dest="moe_combine_dtype",
                    choices=["fp32", "bf16"],
                    help="combine-einsum precision (bf16 halves combine "

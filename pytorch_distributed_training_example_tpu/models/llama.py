@@ -108,7 +108,7 @@ class LlamaBlock(nn.Module):
     num_experts: int = 0     # >0 replaces the SwiGLU MLP with an MoE block (EP)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    moe_dispatch_impl: str = "gather"  # sort | gather | einsum (parallel/moe.py)
+    moe_dispatch_impl: str = "gather"  # sort|gather|einsum|dropless (parallel/moe.py)
     moe_combine_dtype: Any = None      # None -> fp32 combine (exact)
     moe_router_dtype: Any = None       # None -> fp32 logits matmul (exact)
     moe_router_impl: str = "reference"  # reference | fused (ops/fused_router)

@@ -88,11 +88,16 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
 # --moe-router-dtype uses the same spelling for MoEBlock.router_dtype.
 _MOE_COMBINE_DTYPES = {"fp32": None, "bf16": jnp.bfloat16}
 _MOE_ROUTER_IMPLS = ("reference", "fused")
+_MOE_DISPATCH_IMPLS = ("sort", "gather", "einsum", "dropless")
 
 
 def _moe_kwargs(moe_capacity_factor, moe_top_k, moe_dispatch_impl,
                 moe_combine_dtype, moe_router_dtype="fp32",
                 moe_router_impl="reference"):
+    if moe_dispatch_impl not in _MOE_DISPATCH_IMPLS:
+        raise ValueError(
+            f"unknown moe_dispatch_impl {moe_dispatch_impl!r}; "
+            f"have {list(_MOE_DISPATCH_IMPLS)}")
     if moe_combine_dtype not in _MOE_COMBINE_DTYPES:
         raise ValueError(
             f"unknown moe_combine_dtype {moe_combine_dtype!r}; "

@@ -20,17 +20,25 @@ are ``[E]``/``[k·T]``-shaped int32. The routing *decision* (fp32 softmax +
 ``lax.top_k``) is unchanged — the compact path is equivalence-tested
 against the one-hot reference in tests/test_moe_router.py.
 
-Three dispatch implementations share identical routing/drop semantics (the
-priority order is: earlier tokens first, k=0 choices before k=1) and are
-equivalence-tested against each other — see ``dispatch_impl`` on
-``MoEBlock``. The step regions are tagged with ``jax.named_scope`` (
-``moe_router`` / ``moe_dispatch`` / ``moe_experts`` / ``moe_combine`` /
-``moe_aux``) so ``benchmarks/profile_step.py`` can attribute device time
-per region from an xplane trace (PROFILE_MOE.md).
+Three capacity-dropped dispatch implementations share identical
+routing/drop semantics (the priority order is: earlier tokens first, k=0
+choices before k=1) and are equivalence-tested against each other — see
+``dispatch_impl`` on ``MoEBlock``. A fourth, ``"dropless"``, retires the
+capacity machinery entirely (MegaBlocks): the ragged per-expert segments
+the stats' argsort produces feed a Pallas grouped matmul
+(ops/grouped_matmul.py) directly — no ``[E, C, d]`` buffer, no dropped
+tokens, capacity factor irrelevant; it is equivalence-tested against the
+einsum path at a capacity factor high enough to never drop. The step
+regions are tagged with ``jax.named_scope`` (``moe_router`` /
+``moe_dispatch`` / ``moe_experts`` / ``moe_combine`` / ``moe_aux``, plus
+``moe_experts_gmm`` inside the dropless kernel) so
+``benchmarks/profile_step.py`` can attribute device time per region from
+an xplane trace (PROFILE_MOE.md).
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, NamedTuple
 
 import flax.linen as nn
@@ -41,6 +49,27 @@ from jax.sharding import PartitionSpec as P
 from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 
 BATCH = mesh_lib.BATCH_AXES
+
+_capacity_clamp_warned = False
+
+
+def _warn_capacity_clamp(capacity_factor, T, top_k, num_experts):
+    """Loud one-time warning when ``int(cf*T*k/E)`` lands at 0 and the
+    capacity is silently clamped to 1 slot per expert — tiny T·k/E shapes
+    (small batches, many experts) drop almost every token in that regime.
+    Trace-time only (static shapes): no host sync in the compiled step.
+    """
+    global _capacity_clamp_warned
+    if _capacity_clamp_warned:
+        return
+    _capacity_clamp_warned = True
+    warnings.warn(
+        f"MoE expert capacity clamped to 1: int(capacity_factor * T * k / E)"
+        f" = int({capacity_factor} * {T} * {top_k} / {num_experts}) = 0. "
+        f"With one slot per expert most (token, choice) assignments will be "
+        f"DROPPED. Raise capacity_factor / batch size, or switch to "
+        f"dispatch_impl='dropless' (no capacity, no drops). "
+        f"(warned once per process)", RuntimeWarning, stacklevel=3)
 
 
 class ExpertFFN(nn.Module):
@@ -64,6 +93,39 @@ class ExpertFFN(nn.Module):
         out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype),
                          preferred_element_type=jnp.float32).astype(self.dtype)
         return out
+
+
+class GroupedExpertFFN(nn.Module):
+    """Expert MLPs over the SORTED ragged token layout ``[kT, d]`` (dropless).
+
+    Same math as ``ExpertFFN`` but computed by the Pallas grouped matmul
+    (ops/grouped_matmul.py) over contiguous per-expert segments instead of
+    a padded ``[E, C, d]`` einsum. Param names/shapes/init are identical to
+    ``ExpertFFN`` (``w_up`` ``[E, d, f]``, ``w_down`` ``[E, f, d]``,
+    lecun_normal, ``param_dtype``), so checkpoints and the
+    ``experts/w_(up|down)`` sharding rules (EP_RULES, llama TP_RULES) are
+    unchanged when flipping ``dispatch_impl`` to ``"dropless"``.
+    """
+
+    num_experts: int
+    ffn_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x_sorted, starts, counts):  # [kT, d], [E], [E]
+        from pytorch_distributed_training_example_tpu.ops import (
+            grouped_matmul as gmm_lib)
+
+        d = x_sorted.shape[-1]
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (self.num_experts, d, self.ffn_dim), self.param_dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (self.num_experts, self.ffn_dim, d), self.param_dtype)
+        with jax.named_scope("moe_experts_gmm"):
+            return gmm_lib.grouped_ffn(x_sorted, w_up.astype(self.dtype),
+                                       w_down.astype(self.dtype), starts,
+                                       counts)
 
 
 class RouterDense(nn.Module):
@@ -170,6 +232,16 @@ class MoEBlock(nn.Module):
       ``[T, E, C]`` dispatch/combine mask. O(T*E*C) memory; kept because its
       einsums partition very predictably under GSPMD (useful oracle and
       fallback).
+    - ``"dropless"`` (MegaBlocks-style): NO capacity and NO dropped tokens —
+      ``capacity_factor`` is irrelevant. Tokens are gathered once into the
+      stats' sorted layout and the expert FFNs run as ragged grouped Pallas
+      matmuls over the contiguous per-expert segments
+      (ops/grouped_matmul.py); combine is the inverse-permutation gather.
+      ``moe_drop_fraction`` sows an exact constant 0.0. Matches the einsum
+      oracle at a never-drop capacity factor (tests/test_moe_dropless.py);
+      the kernel runs interpret-mode off-TPU and replicated under GSPMD
+      (sharded EP execution of the kernel itself is a chip A/B follow-up —
+      PROFILE_MOE.md r14).
 
     ``router_dtype`` sets the logits-matmul precision (``RouterDense``):
     None/fp32 is the exact ST-MoE contract and the default; bf16 halves the
@@ -198,7 +270,7 @@ class MoEBlock(nn.Module):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 1e-3
-    dispatch_impl: str = "gather"  # "sort" | "gather" | "einsum"
+    dispatch_impl: str = "gather"  # "sort" | "gather" | "einsum" | "dropless"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     combine_dtype: Any = None  # None -> fp32 (exact); bf16 halves combine BW
@@ -211,7 +283,17 @@ class MoEBlock(nn.Module):
         E = self.num_experts
         tokens = x.reshape(B * S, d)
         T = B * S
-        capacity = max(int(self.capacity_factor * T * self.top_k / E), 1)
+        dropless = self.dispatch_impl == "dropless"
+        if dropless:
+            # No capacity in the dropless formulation; a never-drop value
+            # keeps stats.within_cap trivially all-true (and DCE'd — nothing
+            # downstream reads it).
+            capacity = T * self.top_k
+        else:
+            raw_capacity = int(self.capacity_factor * T * self.top_k / E)
+            if raw_capacity < 1:
+                _warn_capacity_clamp(self.capacity_factor, T, self.top_k, E)
+            capacity = max(raw_capacity, 1)
 
         # Router logits in fp32 accumulation (standard for stability); the
         # softmax/top-k decision chain is always fp32.
@@ -240,18 +322,28 @@ class MoEBlock(nn.Module):
 
         with jax.named_scope("moe_dispatch"):
             stats = routing_stats(expert_idx, E, capacity)
-            gate_vals = gate_vals * stats.within_cap
-            # Telemetry (ST-MoE router diagnostics): fraction of
-            # (token, choice) assignments beyond expert capacity — exact
-            # from the shared [E] counts, no mask re-materialized. sow is a
-            # no-op unless the step runs with the "telemetry" collection
-            # mutable (utils/telemetry health pack), and XLA DCEs the
-            # unused reduction in that case.
-            kept = jnp.sum(jnp.minimum(stats.counts, capacity))
-            self.sow("telemetry", "moe_drop_fraction",
-                     1.0 - kept.astype(jnp.float32) / (T * self.top_k))
+            if dropless:
+                # Every (token, choice) is kept by construction: sow the
+                # exact constant 0.0 instead of the within_cap reductions so
+                # XLA DCEs the mask work rather than computing an
+                # identically-zero value.
+                self.sow("telemetry", "moe_drop_fraction",
+                         jnp.zeros((), jnp.float32))
+            else:
+                gate_vals = gate_vals * stats.within_cap
+                # Telemetry (ST-MoE router diagnostics): fraction of
+                # (token, choice) assignments beyond expert capacity — exact
+                # from the shared [E] counts, no mask re-materialized. sow
+                # is a no-op unless the step runs with the "telemetry"
+                # collection mutable (utils/telemetry health pack), and XLA
+                # DCEs the unused reduction in that case.
+                kept = jnp.sum(jnp.minimum(stats.counts, capacity))
+                self.sow("telemetry", "moe_drop_fraction",
+                         1.0 - kept.astype(jnp.float32) / (T * self.top_k))
 
-        if self.dispatch_impl == "sort":
+        if dropless:
+            out = self._dropless_route(tokens, expert_idx, stats, gate_vals)
+        elif self.dispatch_impl == "sort":
             out = self._sort_route(tokens, expert_idx, stats, gate_vals,
                                    capacity)
         elif self.dispatch_impl == "einsum":
@@ -317,6 +409,40 @@ class MoEBlock(nn.Module):
             # reproduced in tests/test_moe_sort_dispatch.py's EP suite).
             out_pad = mesh_lib.constrain(out_pad, P(None, None))
             y = out_pad[slot]                                   # [T, k, d]
+            return jnp.einsum("tk,tkd->td", gate_vals.astype(cdt), y,
+                              preferred_element_type=jnp.float32)
+
+    def _dropless_route(self, tokens, expert_idx, stats, gate_vals):
+        """Dropless dispatch (MegaBlocks): ragged grouped matmul, no capacity.
+
+        The shared stats' stable argsort already lays the (token, choice)
+        pairs out as contiguous per-expert segments, so dispatch is ONE
+        ``[kT, d]`` gather into sorted order and the expert FFNs consume the
+        ragged layout directly via the Pallas gmm kernel with the ``[E]``
+        segment starts/counts — no ``[E, C, d]`` buffer exists in the
+        program. Combine is the scatter-add back through the sort
+        permutation, read-side: the permutation is a bijection (nothing
+        dropped, no trash row), so each (t, k)'s output row sits at
+        ``slot = starts[expert] + pos`` and a gather + gate einsum is exact.
+        """
+        T, d = tokens.shape
+        with jax.named_scope("moe_dispatch"):
+            tok_flat = (stats.order % T).astype(jnp.int32)
+            x_sorted = tokens[tok_flat].astype(self.dtype)       # [kT, d]
+            # Replicate the kernel operands: pallas_call does not partition
+            # under GSPMD (the EP-sharded kernel is a chip A/B follow-up),
+            # and the pin also sidesteps the jax 0.4.x sharded-operand
+            # gather miscompile (see _combine).
+            x_sorted = mesh_lib.constrain(x_sorted, P(None, None))
+        with jax.named_scope("moe_experts"):
+            y_sorted = GroupedExpertFFN(
+                self.num_experts, self.ffn_dim, self.dtype, self.param_dtype,
+                name="experts")(x_sorted, stats.starts, stats.counts)
+        with jax.named_scope("moe_combine"):
+            cdt = self.combine_dtype or jnp.float32
+            slot = stats.starts[expert_idx] + stats.pos          # [T, k]
+            y_sorted = mesh_lib.constrain(y_sorted.astype(cdt), P(None, None))
+            y = y_sorted[slot]                                   # [T, k, d]
             return jnp.einsum("tk,tkd->td", gate_vals.astype(cdt), y,
                               preferred_element_type=jnp.float32)
 

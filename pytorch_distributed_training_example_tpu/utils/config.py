@@ -50,7 +50,7 @@ class Config:
     # MoE routing/dispatch (llama_moe family; parallel/moe.py)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    moe_dispatch_impl: str = "gather"  # sort | gather | einsum
+    moe_dispatch_impl: str = "gather"  # sort | gather | einsum | dropless
     moe_combine_dtype: str = "fp32"  # fp32 (exact) | bf16 (combine-BW A/B)
     moe_router_dtype: str = "fp32"  # fp32 (ST-MoE exact) | bf16 (matmul A/B)
     moe_router_impl: str = "reference"  # reference | fused (Pallas kernel)
