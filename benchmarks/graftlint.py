@@ -511,7 +511,8 @@ def _gl002(mod: Module) -> list[Finding]:
 # GL003: host-sync primitives in step-scope modules
 # ---------------------------------------------------------------------------
 
-GL003_PREFIXES = (f"{PKG}/core/train_loop.py", f"{PKG}/parallel/", f"{PKG}/ops/")
+GL003_PREFIXES = (f"{PKG}/core/train_loop.py", f"{PKG}/parallel/", f"{PKG}/ops/",
+                  f"{PKG}/serve/")
 _SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
 _SYNC_METHODS = {"item", "block_until_ready"}
 
@@ -751,7 +752,8 @@ def _gl004(root: str) -> list[Finding]:
 # GL005: wall-clock / unseeded randomness in seeded chaos & sampler paths
 # ---------------------------------------------------------------------------
 
-GL005_PATHS = (f"{PKG}/utils/chaos.py", f"{PKG}/data/sampler.py")
+GL005_PATHS = (f"{PKG}/utils/chaos.py", f"{PKG}/data/sampler.py",
+               f"{PKG}/serve/engine.py", f"{PKG}/serve/loadgen.py")
 _NP_UNSEEDED = {
     "rand",
     "randn",

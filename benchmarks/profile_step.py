@@ -126,15 +126,22 @@ _MOE_TAG_RE = re.compile(
     r"\bmoe_(router|dispatch|experts_gmm|experts|combine|aux)\b")
 
 
-def _moe_tag(line: str) -> str | None:
+def _moe_tag(line: str, tag_re: re.Pattern | None = None) -> str | None:
+    """Region tag of one HLO line. Default: the MoE named-scope tags.
+    ``tag_re`` swaps in another scope-tag alphabet (e.g. the serve_* tags
+    of the decode step — benchmarks/serve_bench.py) and attributes by the
+    full match text."""
     m = re.search(r'op_name="([^"]+)"', line)
     if not m:
         return None
+    if tag_re is not None:
+        t = tag_re.search(m.group(1))
+        return t.group(0) if t else None
     t = _MOE_TAG_RE.search(m.group(1))
     return f"moe_{t.group(1)}" if t else None
 
 
-def build_op_moe_tags(hlo_text: str):
+def build_op_moe_tags(hlo_text: str, tag_re: re.Pattern | None = None):
     """Map instruction name -> MoE step region (moe_router / moe_dispatch /
     moe_experts / moe_combine / moe_aux) from the named-scope tags in
     op_name metadata. A fusion is attributed to the tag the majority of its
@@ -148,7 +155,7 @@ def build_op_moe_tags(hlo_text: str):
     for name, body in comp_bodies.items():
         c = collections.Counter()
         for line in body.splitlines():
-            t = _moe_tag(line)
+            t = _moe_tag(line, tag_re)
             if t:
                 c[t] += 1
         comp_tags[name] = c
@@ -161,7 +168,7 @@ def build_op_moe_tags(hlo_text: str):
             if not im:
                 continue
             op, opcode = im.group(1), im.group(2)
-            tag = _moe_tag(line)
+            tag = _moe_tag(line, tag_re)
             if opcode == "fusion":
                 cm = re.search(r"calls=%?([\w.\-]+)", line)
                 cnt = comp_tags.get(cm.group(1)) if cm else None
@@ -172,7 +179,7 @@ def build_op_moe_tags(hlo_text: str):
     return op_moe
 
 
-def build_op_moe_weights(hlo_text: str):
+def build_op_moe_weights(hlo_text: str, tag_re: re.Pattern | None = None):
     """Map instruction name -> {region: fraction} for PROPORTIONAL byte
     attribution of mixed fusions.
 
@@ -211,7 +218,7 @@ def build_op_moe_weights(hlo_text: str):
             b = sum(_shape_bytes(dt, dims)
                     for dt, dims, _ in _SHAPE_LAYOUT_RE.findall(im.group(2)))
             total += b
-            t = _moe_tag(line)
+            t = _moe_tag(line, tag_re)
             if t:
                 tag_bytes[t] += b
                 tag_lines[t] += 1
@@ -233,7 +240,7 @@ def build_op_moe_weights(hlo_text: str):
                 if w:
                     op_w[op] = w
                     continue
-            t = _moe_tag(line)
+            t = _moe_tag(line, tag_re)
             if t:
                 op_w[op] = {t: 1.0}
     return op_w

@@ -1,0 +1,356 @@
+#!/usr/bin/env python
+"""Serving benchmark: continuous batching under open-loop Poisson load.
+
+Chipless by design — the whole pipeline (paged KV cache, bucketed AOT
+prefill/decode, admission/eviction) runs on CPU exactly as it would on a
+TPU pod, so this doubles as the end-to-end CI leg. Two measured phases:
+
+- ``batch1``: closed-loop, one request at a time — the interactive
+  latency floor (tokens/sec/chip at batch 1).
+- ``saturation``: the full request set under the open-loop arrival
+  schedule (``--rate`` req/s Poisson, or everything at t=0 when 0) — the
+  throughput ceiling plus honest p50/p99 TTFT and inter-token latency,
+  because an open loop keeps arriving while the engine is saturated.
+
+``--aot`` emits the chipless byte/FLOP model of the decode step instead:
+the same ``jit(...).lower(abstract).compile()`` front-end as
+profile_step.py, with per-region HBM bytes attributed by the serve_*
+named-scope tags (serve_cache / serve_attn / serve_mlp / serve_head) and
+gated in CI by ``check_regression.py --aot-bytes`` against the
+``aot_regions`` golden (key ``<model>_decode b<bucket> s<max_len> -``).
+
+Human-readable progress goes to stderr; the result JSON to stdout
+(pipeable into check_regression.py, like bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: Named-scope tags the decode forward emits (models/llama.py decode path).
+SERVE_TAG_RE = re.compile(r"\bserve_(embed|cache|attn|mlp|head)\b")
+
+
+def _say(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_serving(model_name: str, *, page_size: int, num_pages: int,
+                  max_model_len: int, precision: str = "fp32", seed: int = 0):
+    """Model + initialized params + cache geometry for serving."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve import engine as engine_lib
+
+    dtype = jnp.float32 if precision == "fp32" else jnp.bfloat16
+    bundle = registry.create_model(model_name, seq_len=max_model_len,
+                                   dtype=dtype, param_dtype=dtype)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    spec = engine_lib.spec_for_module(module, num_pages=num_pages,
+                                      page_size=page_size)
+    return module, params, spec
+
+
+def _pct_ms(xs, q) -> float | None:
+    return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 3) if xs \
+        else None
+
+
+def latency_summary(done, wall_s: float, num_chips: int) -> dict:
+    tokens = sum(len(r.generated) for r in done)
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    itls = [d for r in done for d in r.inter_token_s()]
+    tps = tokens / max(wall_s, 1e-9)
+    return {
+        "requests": len(done),
+        "tokens_generated": tokens,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_s": round(tps, 2),
+        "tokens_per_s_per_chip": round(tps / max(num_chips, 1), 2),
+        "ttft_ms": {"p50": _pct_ms(ttfts, 50), "p99": _pct_ms(ttfts, 99)},
+        "inter_token_ms": {"p50": _pct_ms(itls, 50), "p99": _pct_ms(itls, 99)},
+    }
+
+
+def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
+              telemetry=None, metrics=None) -> dict:
+    from pytorch_distributed_training_example_tpu.serve import engine as engine_lib
+    from pytorch_distributed_training_example_tpu.serve import loadgen
+
+    buckets = (1,) if closed_loop else args.decode_buckets
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=buckets,
+        prompt_buckets=args.prompt_buckets,
+        max_model_len=args.max_model_len, telemetry=telemetry,
+        metrics=metrics)
+    n_exec = eng.warmup()
+    t0 = time.perf_counter()
+    if closed_loop:
+        for req in requests:
+            eng.submit(req)
+            eng.run()
+    else:
+        driver = loadgen.OpenLoopDriver(requests)
+        while driver.remaining or eng.has_work:
+            driver.pump(eng, time.perf_counter() - t0)
+            if eng.has_work:
+                eng.step()
+            else:
+                time.sleep(0.0005)  # idle until the next scheduled arrival
+    wall = time.perf_counter() - t0
+    import jax
+
+    out = latency_summary(eng.completed, wall, jax.device_count())
+    out.update(executables=n_exec, compiles=eng.stats["compiles"],
+               decode_steps=eng.stats["decode_steps"],
+               evictions=eng.stats["evictions"])
+    assert eng.stats["compiles"] == n_exec, \
+        f"steady-state recompile: {eng.stats['compiles']} > {n_exec}"
+    return out
+
+
+def aot_decode_report(model_name: str, *, batch: int, page_size: int,
+                      num_pages: int, max_model_len: int,
+                      precision: str = "fp32") -> dict:
+    """Chipless AOT byte/FLOP model of ONE decode step at one batch bucket.
+
+    Same scheme as profile_step.aot_report: lower the exact engine decode
+    program with abstract inputs, tabulate modeled HBM bytes per serve_*
+    named-scope region with proportional fusion attribution, and stamp the
+    lowering backend so goldens never compare across backends."""
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+
+    import profile_step
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+        pages_for_tokens)
+
+    dtype = jnp.float32 if precision == "fp32" else jnp.bfloat16
+    bundle = registry.create_model(model_name, seq_len=max_model_len,
+                                   dtype=dtype, param_dtype=dtype)
+    module = bundle.module
+    table_width = pages_for_tokens(max_model_len, page_size)
+    sds = jax.ShapeDtypeStruct
+    tok = sds((batch, 1), jnp.int32)
+    pos = sds((batch, 1), jnp.int32)
+    table = sds((batch, table_width), jnp.int32)
+    last = sds((batch,), jnp.int32)
+
+    def ctx(positions, page_table, last_index):
+        return dict(positions=positions, page_table=page_table,
+                    cache_spec=(num_pages, page_size),
+                    last_index=last_index, attn_impl="auto")
+
+    def init_fn(rng, tokens, positions, page_table, last_index):
+        return module.init(rng, tokens, train=False,
+                           decode_ctx=ctx(positions, page_table, last_index))
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0), tok, pos, table,
+                            last)
+    params_abs, cache_abs = shapes["params"], shapes["cache"]
+
+    def run(params, cache, tokens, positions, page_table, last_index):
+        logits, vs = module.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            decode_ctx=ctx(positions, page_table, last_index),
+            mutable=["cache"])
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), vs["cache"]
+
+    compiled = jax.jit(run, donate_argnums=1).lower(
+        params_abs, cache_abs, tok, pos, table, last).compile()
+    hlo_text = compiled.as_text()
+    op_cat, _ = profile_step.build_op_categories(hlo_text)
+    op_bytes = profile_step.build_op_bytes(hlo_text)
+    op_tag = profile_step.build_op_moe_tags(hlo_text, tag_re=SERVE_TAG_RE)
+    op_w = profile_step.build_op_moe_weights(hlo_text, tag_re=SERVE_TAG_RE)
+    op_interior = profile_step.build_pallas_interior(hlo_text)
+
+    regions: dict[str, dict] = {}
+
+    def row(tag):
+        return regions.setdefault(tag, {"ops": 0, "gbytes_modeled": 0.0,
+                                        "by_category": collections.Counter()})
+
+    for op, b in op_bytes.items():
+        if op in op_interior:
+            continue
+        assigned = 0.0
+        for tag, frac in op_w.get(op, {}).items():
+            row(tag)["gbytes_modeled"] += b * frac / 1e9
+            assigned += frac
+        if assigned < 1.0:
+            row("other")["gbytes_modeled"] += b * (1.0 - assigned) / 1e9
+        r = row(op_tag.get(op, "other"))
+        r["ops"] += 1
+        if b or op_cat.get(op) not in (None, "copy_layout"):
+            r["by_category"][op_cat.get(op, "?")] += 1
+    for r in regions.values():
+        r["gbytes_modeled"] = round(r["gbytes_modeled"], 4)
+        r["by_category"] = dict(r["by_category"].most_common(6))
+    try:
+        ca = compiled.cost_analysis() or {}
+    except Exception:
+        ca = {}
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return {
+        "mode": "aot_hlo_model",
+        "attribution": "proportional_bytes",
+        "backend_lowering": jax.default_backend(),
+        "model": f"{model_name}_decode",
+        "per_chip_batch": batch,
+        "seq_len": max_model_len,       # KV capacity: the decode shape knob
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "precision": precision,
+        "xla_flops_per_step": ca.get("flops"),
+        "xla_bytes_accessed": ca.get("bytes accessed"),
+        "regions": dict(sorted(regions.items(),
+                               key=lambda kv: -kv[1]["gbytes_modeled"])),
+    }
+
+
+def _int_tuple(text: str) -> tuple[int, ...]:
+    return tuple(int(t) for t in text.split(",") if t)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama_tiny")
+    p.add_argument("--precision", default="fp32", choices=("fp32", "bf16"))
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=128)
+    p.add_argument("--max-model-len", type=int, default=128)
+    p.add_argument("--decode-buckets", type=_int_tuple, default=(1, 2, 4, 8))
+    p.add_argument("--prompt-buckets", type=_int_tuple, default=(16, 32))
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open-loop Poisson arrivals per second; 0 = all "
+                        "requests arrive at t=0 (saturation)")
+    p.add_argument("--prompt-len", default="4:24", help="min:max prompt len")
+    p.add_argument("--max-new", default="4:24", help="min:max new tokens")
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip-batch1", action="store_true")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="start a fleetobs MetricsServer (0 = ephemeral) and "
+                        "export pdtx_serve_* gauges")
+    p.add_argument("--trace-dir", default=None,
+                   help="write trace_events.json/goodput.json here")
+    p.add_argument("--aot", action="store_true",
+                   help="emit the chipless AOT decode-step byte model "
+                        "instead of running load")
+    p.add_argument("--aot-bucket", type=int, default=None,
+                   help="with --aot: single-bucket report JSON on stdout "
+                        "(pipe into check_regression.py --aot-bytes)")
+    p.add_argument("--json", default=None, help="also write result JSON here")
+    args = p.parse_args(argv)
+
+    result: dict = {"mode": "serve_bench", "model": args.model,
+                    "page_size": args.page_size, "num_pages": args.num_pages,
+                    "max_model_len": args.max_model_len,
+                    "decode_buckets": list(args.decode_buckets),
+                    "prompt_buckets": list(args.prompt_buckets),
+                    "seed": args.seed}
+
+    if args.aot:
+        buckets = ([args.aot_bucket] if args.aot_bucket
+                   else list(args.decode_buckets))
+        reports = []
+        for b in buckets:
+            _say(f"serve_bench: AOT decode model, bucket {b}")
+            reports.append(aot_decode_report(
+                args.model, batch=b, page_size=args.page_size,
+                num_pages=args.num_pages, max_model_len=args.max_model_len,
+                precision=args.precision))
+        if args.aot_bucket:
+            print(json.dumps(reports[0], indent=2))
+            return 0
+        result["aot"] = reports
+        print(json.dumps(result, indent=2))
+        if args.json:
+            with open(args.json, "w") as fh:
+                json.dump(result, fh, indent=2)
+        return 0
+
+    from pytorch_distributed_training_example_tpu.serve import loadgen
+    from pytorch_distributed_training_example_tpu.utils import telemetry as tele
+
+    pl_min, pl_max = (int(t) for t in args.prompt_len.split(":"))
+    mn_min, mn_max = (int(t) for t in args.max_new.split(":"))
+    module, params, spec = build_serving(
+        args.model, page_size=args.page_size, num_pages=args.num_pages,
+        max_model_len=args.max_model_len, precision=args.precision,
+        seed=args.seed)
+    vocab = int(module.vocab_size)
+    mkload = lambda rate, n, seed: loadgen.generate_requests(loadgen.LoadSpec(
+        num_requests=n, rate=rate, prompt_len_min=pl_min,
+        prompt_len_max=pl_max, max_new_min=mn_min, max_new_max=mn_max,
+        vocab_size=vocab, eos_id=args.eos_id, seed=seed))
+
+    metrics = None
+    if args.metrics_port is not None:
+        from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+        metrics = fleetobs.MetricsServer(port=args.metrics_port).start()
+        _say(f"serve_bench: /metrics on port {metrics.port}")
+        result["metrics_port"] = metrics.port
+    recorder = tele.SpanRecorder(run_id=f"serve_bench_s{args.seed}")
+
+    if not args.skip_batch1:
+        _say("serve_bench: phase batch1 (closed loop)")
+        result["batch1"] = run_phase(
+            module, params, spec, args, mkload(0.0, min(args.requests, 8),
+                                               args.seed + 1),
+            closed_loop=True, telemetry=recorder, metrics=metrics)
+        _say(f"  batch1: {result['batch1']['tokens_per_s_per_chip']} tok/s/chip")
+    _say(f"serve_bench: phase saturation (open loop, rate={args.rate})")
+    result["saturation"] = run_phase(
+        module, params, spec, args, mkload(args.rate, args.requests,
+                                           args.seed),
+        closed_loop=False, telemetry=recorder, metrics=metrics)
+    sat = result["saturation"]
+    _say(f"  saturation: {sat['tokens_per_s_per_chip']} tok/s/chip, "
+         f"ttft p50/p99 {sat['ttft_ms']['p50']}/{sat['ttft_ms']['p99']} ms, "
+         f"itl p50/p99 {sat['inter_token_ms']['p50']}"
+         f"/{sat['inter_token_ms']['p99']} ms")
+    result["goodput"] = {k: recorder.goodput()[k]
+                         for k in ("goodput_fraction", "coverage", "wall_s",
+                                   "categories_s")}
+    if args.trace_dir:
+        recorder.write(args.trace_dir)
+        _say(f"serve_bench: wrote trace/goodput to {args.trace_dir}")
+    if metrics is not None:
+        result["metrics_snapshot"] = {
+            k: v for k, v in metrics.snapshot().items()
+            if k.startswith("serve_")}
+        metrics.stop()
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

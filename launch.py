@@ -29,6 +29,11 @@ size instead of the original one. The abrupt host-loss exit code
 when ``--elastic`` is set. Below MIN the supervisor gives up; the trainer
 side (``main.py --elastic``) rebuilds the mesh at the new size and rescales
 the batch geometry under ``--elastic-policy`` (utils/elastic.py).
+
+The world also grows back: a host-return record (``returned_hosts.jsonl``,
+written by whoever notices the repair — a node manager, a probe, the host
+itself) cancels its dead record, and the next relaunch runs at
+``base_world - |currently dead|``, capped by MAX and the launch-time size.
 """
 
 from __future__ import annotations
@@ -46,12 +51,12 @@ try:
     from pytorch_distributed_training_example_tpu.utils.resilience import (
         HOST_LOST_EXIT_CODE, PREEMPTED_EXIT_CODE)
     from pytorch_distributed_training_example_tpu.utils.elastic import (
-        read_dead_hosts)
+        effective_dead_hosts)
 except ImportError:  # stripped deployments: keep the launcher standalone
     PREEMPTED_EXIT_CODE = 75
     HOST_LOST_EXIT_CODE = 76
 
-    def read_dead_hosts(directory):
+    def effective_dead_hosts(directory):
         return set()
 
 
@@ -273,6 +278,7 @@ def supervise(args, cmd, elastic) -> int:
     # (the single-process local pod used by tests and dryrun drills).
     world_attr = "nprocs" if args.nprocs > 1 else "cpu_devices"
     dead_seen: set[int] = set()
+    base_world: int | None = None  # launch-time size: the grow ceiling
 
     restarts = 0
     while True:
@@ -293,21 +299,35 @@ def supervise(args, cmd, elastic) -> int:
             return code
         if elastic is not None:
             ckdir = find_flag(cmd, "--checkpoint-dir")
-            new_dead = (read_dead_hosts(ckdir) - dead_seen) if ckdir else set()
-            if new_dead:
-                dead_seen |= new_dead
+            # Absolute accounting, not incremental: the next world size is
+            # always base_world minus the hosts dead RIGHT NOW (dead minus
+            # returned, count-based), so a host-return record GROWS the
+            # world back — capped by the launch-time size and --elastic MAX.
+            dead_now = effective_dead_hosts(ckdir) if ckdir else set()
+            new_dead = dead_now - dead_seen
+            returned = dead_seen - dead_now
+            if new_dead or returned:
+                dead_seen = dead_now
                 world = getattr(args, world_attr) or 1
+                if base_world is None:
+                    # First size change: ``world`` is still the launch size.
+                    base_world = world
                 min_world, max_world = elastic
-                new_world = min(max(world - len(new_dead), 0), max_world)
+                new_world = min(max(base_world - len(dead_now), 0), max_world)
                 if new_world < min_world:
                     print(f"launch.py: elastic give-up — {len(new_dead)} "
                           f"host(s) {sorted(new_dead)} lost, surviving world "
                           f"{new_world} is below --elastic min {min_world}",
                           file=sys.stderr)
                     return code
-                print(f"launch.py: elastic — host(s) {sorted(new_dead)} "
-                      f"lost, relaunching at world size {new_world} "
-                      f"(was {world})", file=sys.stderr)
+                if new_dead:
+                    print(f"launch.py: elastic — host(s) {sorted(new_dead)} "
+                          f"lost, relaunching at world size {new_world} "
+                          f"(was {world})", file=sys.stderr)
+                if returned:
+                    print(f"launch.py: elastic — host(s) {sorted(returned)} "
+                          f"returned, relaunching at world size {new_world} "
+                          f"(was {world})", file=sys.stderr)
                 setattr(args, world_attr, new_world)
         restarts += 1
         delay = args.restart_backoff * 2 ** (restarts - 1)

@@ -193,6 +193,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="coordinator address host:port (else env)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--serve", action="store_true", default=None,
+                   help="run the continuous-batching decode engine (serve/) "
+                        "instead of training; --resume restores params only")
+    p.add_argument("--serve-page-size", type=int, default=None,
+                   dest="serve_page_size",
+                   help="KV cache page size in tokens (default 16)")
+    p.add_argument("--serve-num-pages", type=int, default=None,
+                   dest="serve_num_pages",
+                   help="KV cache pool size in pages (default 128)")
+    p.add_argument("--serve-max-model-len", type=int, default=None,
+                   dest="serve_max_model_len",
+                   help="per-request token cap; 0 = model/cache capacity")
+    p.add_argument("--serve-decode-buckets", default=None,
+                   dest="serve_decode_buckets",
+                   help="comma-separated padded decode batch sizes")
+    p.add_argument("--serve-prompt-buckets", default=None,
+                   dest="serve_prompt_buckets",
+                   help="comma-separated padded prefill prompt lengths")
+    p.add_argument("--serve-requests", type=int, default=None,
+                   dest="serve_requests",
+                   help="number of synthetic requests to drain")
+    p.add_argument("--serve-rate", type=float, default=None, dest="serve_rate",
+                   help="open-loop Poisson arrival rate (req/s); 0 = all at "
+                        "t=0 (saturation)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,
@@ -260,6 +284,12 @@ def main(argv=None):
                                        args.process_id)
 
     cfg = config_from_args(args)
+
+    if cfg.serve:
+        from pytorch_distributed_training_example_tpu.serve import run as serve_run
+
+        serve_run.main(cfg)
+        return 0
 
     from pytorch_distributed_training_example_tpu.core.trainer import Trainer
 

@@ -475,6 +475,24 @@ class Checkpointer:
             f"every committed checkpoint in {self.directory} "
             f"({candidates}) failed to restore") from last_err
 
+    def restore_params(self, params_template, step: int | None = None):
+        """Params-only restore for inference/serving. Returns (params, extra).
+
+        ``params_template`` is the model's params pytree (real arrays or
+        ``jax.ShapeDtypeStruct``-like leaves; leaves with ``.sharding``
+        re-shard exactly as in ``restore``). Only the checkpoint files
+        backing model parameters are CRC-verified and read — optimizer
+        state, which dominates checkpoint bytes, is never touched, so a
+        serving host pays a fraction of the resume-time I/O. The match is
+        all-or-nothing like a full restore: serving a half-initialized
+        model is the same silent garbage as training one.
+        """
+        # The manifest namespaces model parameters under "params/..."
+        # (TrainState field name); wrapping reproduces that namespace so
+        # the integrity pre-pass and assembly skip every other leaf.
+        wrapped, extra = self.restore({"params": params_template}, step=step)
+        return wrapped["params"], extra
+
     def _restore_step(self, state_template, step: int,
                       allow_partial: bool = False):
         step_dir = os.path.join(self.directory, f"step_{step:08d}")

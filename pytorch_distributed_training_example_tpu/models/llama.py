@@ -15,6 +15,7 @@ layer dim handled by the partition rules).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 import flax.linen as nn
@@ -73,7 +74,7 @@ class LlamaAttention(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, decode_ctx: dict | None = None):
         B, S, d = x.shape
         dg = lambda heads, name: nn.DenseGeneral(
             (heads, self.head_dim), axis=-1, use_bias=False, dtype=self.dtype,
@@ -81,6 +82,8 @@ class LlamaAttention(nn.Module):
         q = dg(self.num_heads, "query")(x)
         k = dg(self.num_kv_heads, "key")(x)
         v = dg(self.num_kv_heads, "value")(x)
+        if decode_ctx is not None:
+            return self._decode(q, k, v, d, decode_ctx)
         positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
         q = rope(q, positions, self.rope_theta)
         k = rope(k, positions, self.rope_theta)
@@ -91,6 +94,48 @@ class LlamaAttention(nn.Module):
         # Named for the "attn_out" remat policy (save attention outputs,
         # recompute everything else): a no-op unless that policy is active.
         out = ad_checkpoint.checkpoint_name(out, "attn_out")
+        return nn.DenseGeneral(d, axis=(-2, -1), use_bias=False,
+                               dtype=self.dtype, param_dtype=self.param_dtype,
+                               name="out")(out)
+
+    def _decode(self, q, k, v, d, decode_ctx):
+        """Serving path (serve/): RoPE at explicit per-request positions,
+        K/V appended through the page table into this layer's pools (the
+        flax ``cache`` collection — the engine threads it through each step
+        via ``mutable=["cache"]`` and donates the buffers), then attention
+        reads the cache. S == 1 is a decode step (paged flash-decode
+        kernel); S > 1 is prefill of fresh prompts starting at position 0,
+        where causal self-attention over the chunk IS the full answer, so
+        it reuses the training dispatcher for exact parity."""
+        from pytorch_distributed_training_example_tpu.ops import (
+            flash_attention as flash_lib)
+        from pytorch_distributed_training_example_tpu.serve import kv_cache
+
+        B, S = q.shape[0], q.shape[1]
+        positions = decode_ctx["positions"]             # [B, S] int32
+        page_table = decode_ctx["page_table"]           # [B, max_pages]
+        num_pages, page_size = decode_ctx["cache_spec"]
+        q = rope(q, positions, self.rope_theta)
+        k = rope(k, positions, self.rope_theta)
+        init = lambda: jnp.zeros(
+            (num_pages, page_size, self.num_kv_heads, self.head_dim),
+            self.dtype)
+        k_pages = self.variable("cache", "k_pages", init)
+        v_pages = self.variable("cache", "v_pages", init)
+        with jax.named_scope("serve_cache"):
+            k_pages.value = kv_cache.append_pages(k_pages.value, k,
+                                                  page_table, positions)
+            v_pages.value = kv_cache.append_pages(v_pages.value, v,
+                                                  page_table, positions)
+        with jax.named_scope("serve_attn"):
+            if S == 1:
+                out = flash_lib.paged_decode_attention(
+                    q[:, 0], k_pages.value, v_pages.value, page_table,
+                    positions[:, 0],
+                    impl=decode_ctx.get("attn_impl", "auto"))[:, None]
+            else:
+                out = attn_lib.attention(q, k, v, causal=True,
+                                         impl=self.attn_impl)
         return nn.DenseGeneral(d, axis=(-2, -1), use_bias=False,
                                dtype=self.dtype, param_dtype=self.param_dtype,
                                name="out")(out)
@@ -115,16 +160,21 @@ class LlamaBlock(nn.Module):
     sp: bool = False
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, decode_ctx: dict | None = None):
         rn = lambda name: RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                                   name=name)
         x = x + LlamaAttention(self.num_heads, self.num_kv_heads, self.head_dim,
                                self.rope_theta, self.dtype, self.param_dtype,
-                               self.attn_impl, name="attn")(rn("attn_norm")(x), train)
+                               self.attn_impl, name="attn")(rn("attn_norm")(x), train,
+                                                            decode_ctx)
         x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
         h = rn("mlp_norm")(x)
         d = x.shape[-1]
         if self.num_experts > 0:
+            if decode_ctx is not None:
+                raise NotImplementedError(
+                    "the serving decode path does not support MoE blocks yet "
+                    "(ROADMAP: serving follow-ups)")
             from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
 
             h = MoEBlock(self.num_experts, self.ffn_dim,
@@ -137,14 +187,17 @@ class LlamaBlock(nn.Module):
                          dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
         else:
+            scope = (jax.named_scope("serve_mlp") if decode_ctx is not None
+                     else contextlib.nullcontext())
             dense = lambda feat, name: nn.Dense(
                 feat, use_bias=False, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=name)
-            gate = dense(self.ffn_dim, "gate")(h)
-            up = dense(self.ffn_dim, "up")(h)
-            gate = mesh_lib.constrain(gate, P(BATCH, "context", "model"))
-            up = mesh_lib.constrain(up, P(BATCH, "context", "model"))
-            h = dense(d, "down")(nn.silu(gate) * up)
+            with scope:
+                gate = dense(self.ffn_dim, "gate")(h)
+                up = dense(self.ffn_dim, "up")(h)
+                gate = mesh_lib.constrain(gate, P(BATCH, "context", "model"))
+                up = mesh_lib.constrain(up, P(BATCH, "context", "model"))
+                h = dense(d, "down")(nn.silu(gate) * up)
         x = x + h
         return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
 
@@ -200,7 +253,19 @@ class Llama(nn.Module):
         return self.d_model // self.num_heads
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True,
+                 decode_ctx: dict | None = None):
+        """``decode_ctx`` switches to the serving forward (serve/engine.py):
+        a dict with ``positions`` [B,S], ``page_table`` [B,max_pages],
+        ``cache_spec`` (num_pages, page_size), ``last_index`` [B] and
+        optionally ``attn_impl``. K/V live in the flax ``cache`` collection
+        (paged pools); the return value is next-token logits [B, vocab]
+        taken at ``last_index`` instead of the full [B, S, vocab]."""
+        if decode_ctx is not None and self.scan_layers:
+            raise NotImplementedError(
+                "the serving decode path requires unscanned blocks "
+                "(scan_layers=False): the paged cache pools are per-block "
+                "variables, not a stacked carry")
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
         x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
@@ -243,7 +308,24 @@ class Llama(nn.Module):
             x, _ = ScanBlocks(name="blocks")(x, None)
         else:
             for i in range(self.num_layers):
-                x = block_cls(name=f"block_{i}", **block_args)(x, train)
+                x = block_cls(name=f"block_{i}", **block_args)(x, train,
+                                                               decode_ctx)
+        if decode_ctx is not None:
+            # Serving: only the last real position's logits matter (the
+            # next-token distribution). Gather the hidden row BEFORE the
+            # [d, vocab] head matmul — at decode S == 1 this is free, at
+            # prefill it turns a [B,S,V] matmul into [B,V].
+            with jax.named_scope("serve_head"):
+                idx = decode_ctx["last_index"].astype(jnp.int32)  # [B]
+                x = jnp.take_along_axis(
+                    x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+                x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                            name="final_norm")(x)
+                logits = nn.Dense(self.vocab_size, use_bias=False,
+                                  dtype=self.dtype,
+                                  param_dtype=self.param_dtype,
+                                  name="lm_head")(x)
+            return logits.astype(self.logits_dtype)
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,

@@ -1025,3 +1025,165 @@ def _vjp_bwd(causal, block_q, block_kv, impl, kv_len, res, g):
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention (serving): one query token per request, K/V read
+# through a per-request page table into the preallocated page pool
+# (serve/kv_cache.py). Forward-only — no vjp; decode never differentiates.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, sm_scale: float,
+                         page_size: int, num_kv_heads: int):
+    """Grid (B, max_pages), pages innermost ("arbitrary": online-softmax
+    state persists in VMEM scratch across page steps, exactly the online
+    kernels' scheme with the page table standing in for ONLINE_BLOCK_TABLE
+    block indexing). ``pt_ref``/``pos_ref`` are the scalar-prefetched page
+    table and query positions — the same values the in_specs' index_maps
+    used to pick which physical page this step streams."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    pos = pos_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # A page whose first slot is past the query position is fully masked.
+    @pl.when(p * page_size <= pos)
+    def _compute():
+        q = _mxu(q_ref[0])                       # [H, D]
+        k = _mxu(k_ref[0])                       # [page_size, Hkv, D]
+        v = _mxu(v_ref[0])
+        H = q.shape[0]
+        G = H // num_kv_heads
+        # GQA without materializing repeated KV heads: per KV head, the G
+        # grouped query heads share one [page_size, D] key tile.
+        logits = jnp.concatenate([
+            jax.lax.dot_general(
+                q[h * G:(h + 1) * G], k[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for h in range(num_kv_heads)], axis=0) * sm_scale  # [H, ps]
+        k_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(k_pos <= pos, logits, NEG_INF)
+
+        m_prev = m_ref[:, :1]                    # [H, 1] (lane-bcast)
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+        prob = jnp.exp(logits - m_new)           # [H, ps]
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * correction + jnp.sum(prob, axis=1, keepdims=True),
+            l_ref.shape)
+        pv = jnp.concatenate([
+            jax.lax.dot_general(
+                prob[h * G:(h + 1) * G].astype(v.dtype), v[:, h, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for h in range(num_kv_heads)], axis=0)  # [H, D]
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, page_table, positions,
+                         sm_scale):
+    B, H, D = q.shape
+    _, page_size, num_kv_heads, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, p, pt, pos: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, num_kv_heads, D),
+                         lambda b, p, pt, pos: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, num_kv_heads, D),
+                         lambda b, p, pt, pos: (pt[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, p, pt, pos: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),   # m
+            pltpu.VMEM((H, 128), jnp.float32),   # l
+            pltpu.VMEM((H, D), jnp.float32),     # acc
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                          page_size=page_size, num_kv_heads=num_kv_heads),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        # Non-TPU backends run the identical kernel body interpreted — the
+        # parity tests exercise this exact code path on CPU.
+        interpret=jax.default_backend() != "tpu",
+    )(page_table, positions, q, k_pages, v_pages)
+
+
+def _paged_decode_xla(q, k_pages, v_pages, page_table, positions, sm_scale):
+    """Gather-based reference/CPU path: materialize each request's logical
+    KV view from the pool, then masked softmax in fp32 (same math as the
+    ``attention.dot_product_attention`` oracle the training forward uses —
+    the prefill/decode parity tests lean on that)."""
+    B, H, D = q.shape
+    _, page_size, num_kv_heads, _ = k_pages.shape
+    S = page_table.shape[1] * page_size
+    flat = page_table.reshape(-1)
+    k = jnp.take(k_pages, flat, axis=0).reshape(B, S, num_kv_heads, D)
+    v = jnp.take(v_pages, flat, axis=0).reshape(B, S, num_kv_heads, D)
+    G = H // num_kv_heads
+    qg = q.reshape(B, num_kv_heads, G, D)
+    logits = jnp.einsum("bhgd,bshd->bhgs", _mxu(qg), _mxu(k),
+                        preferred_element_type=jnp.float32) * sm_scale
+    mask = jnp.arange(S)[None, :] <= positions[:, None]          # [B, S]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    prob = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", prob, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, positions,
+                           impl: str = "auto"):
+    """Decode-mode attention through a paged KV cache.
+
+    q:          [B, H, D] — ONE query token per request (the decode step)
+    k_pages:    [num_pages, page_size, Hkv, D] pool (one layer's K)
+    v_pages:    same shape, the layer's V
+    page_table: [B, max_pages] int32 physical page ids; entries past a
+                request's length may be garbage (they are masked)
+    positions:  [B] int32 position of the query token; keys at positions
+                <= positions[b] are attended (the query's own K/V must
+                already be appended — the model appends before attending)
+
+    GQA is served natively: KV heads stay folded (H % Hkv == 0), queries
+    are grouped per KV head. ``impl``: "auto" picks the Pallas page-table
+    kernel on TPU and the gather-based XLA path elsewhere; "pallas"/"xla"
+    force (the Pallas kernel runs interpreted off-TPU — that is the
+    parity-test configuration).
+    """
+    B, H, D = q.shape
+    num_kv_heads = k_pages.shape[2]
+    if H % num_kv_heads:
+        raise ValueError(f"H={H} not a multiple of Hkv={num_kv_heads}")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown paged decode impl {impl!r}")
+    sm_scale = 1.0 / math.sqrt(D)
+    page_table = page_table.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    if impl == "pallas" or (impl == "auto"
+                            and jax.default_backend() == "tpu"):
+        return _paged_decode_pallas(q, k_pages, v_pages, page_table,
+                                    positions, sm_scale)
+    return _paged_decode_xla(q, k_pages, v_pages, page_table, positions,
+                             sm_scale)

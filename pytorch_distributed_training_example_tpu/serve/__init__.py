@@ -1,0 +1,13 @@
+"""Serving subsystem: continuous-batching decode over a paged GQA KV cache.
+
+Layout mirrors the training stack it reuses:
+
+- ``kv_cache``  — page pool + page tables (the vLLM-style memory layer)
+- ``engine``    — bucketed AOT prefill/decode steps + continuous batching
+- ``loadgen``   — seeded open-loop Poisson request generator
+- ``aot``       — chipless AOT byte/FLOP model of the decode step
+"""
+
+from pytorch_distributed_training_example_tpu.serve.kv_cache import (  # noqa: F401
+    CacheSpec, PagePool, append_pages, gather_pages, init_cache,
+    pages_for_tokens)

@@ -1,0 +1,401 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The serving loop that turns the trainer's forward pass into a token
+stream: requests are admitted and retired BETWEEN decode steps (Orca-style
+iteration-level scheduling), so a long generation never holds the batch
+hostage and a finished request's pages return to the pool immediately.
+
+Shape discipline is the whole design: every compiled program runs at one
+of a small set of padded BATCH BUCKETS (and, for prefill, prompt-length
+buckets), all AOT-compiled at warmup through the same
+``jit(...).lower(abstract).compile()`` front-end the r13 profile/lint
+stack uses — steady-state continuous batching therefore NEVER recompiles
+(``stats["compiles"]`` is flat after warmup; asserted in tests). Inactive
+rows in a bucket carry token 0, position 0 and a page table full of the
+reserved scratch page, so their lanes compute garbage that is never read.
+
+Host/device split per step: exactly ONE device->host sync (the batched
+next-token fetch that stop conditions need); admission, page allocation
+and eviction are pure host bookkeeping on the ``PagePool`` free list.
+
+Eviction: a slot that cannot get a page (pool exhausted) evicts the
+YOUNGEST active request — its pages free immediately and the request
+re-queues at the head of the waiting line, to be recomputed when pressure
+drops (recompute-on-readmit, the classic vLLM preemption policy).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_example_tpu.serve import kv_cache
+from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+    CacheSpec, PagePool, pages_for_tokens)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. The engine fills the runtime fields."""
+
+    request_id: str
+    prompt: list[int]
+    max_new_tokens: int
+    eos_id: int | None = None
+    arrival_time: float = 0.0
+    # --- runtime (engine-owned) ---
+    generated: list[int] = dataclasses.field(default_factory=list)
+    submit_t: float | None = None
+    first_token_t: float | None = None
+    token_times: list[float] = dataclasses.field(default_factory=list)
+    evictions: int = 0
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.submit_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    def inter_token_s(self) -> list[float]:
+        return [b - a for a, b in zip(self.token_times, self.token_times[1:])]
+
+    def finished(self, max_len: int) -> bool:
+        if self.eos_id is not None and self.generated \
+                and self.generated[-1] == self.eos_id:
+            return True
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return len(self.prompt) + len(self.generated) >= max_len
+
+
+def spec_for_module(module, *, num_pages: int, page_size: int) -> CacheSpec:
+    """Cache geometry from a decode-capable model's own attributes, so the
+    pools always match the flax ``cache`` variables the model declares."""
+    return CacheSpec(num_layers=module.num_layers, num_pages=num_pages,
+                     page_size=page_size, num_kv_heads=module.num_kv_heads,
+                     head_dim=module.head_dim, dtype=module.dtype)
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+class ContinuousBatchingEngine:
+    """Greedy decode with iteration-level scheduling.
+
+    ``module`` is the flax model (decode-capable: ``decode_ctx`` kwarg),
+    ``params`` its restored parameters. ``telemetry`` (a
+    ``SpanRecorder``) and ``metrics`` (a fleetobs ``MetricsServer``) are
+    optional; when present the engine records prefill/step goodput spans
+    and exports ``pdtx_serve_*`` gauges.
+    """
+
+    def __init__(self, module, params, spec: CacheSpec, *,
+                 decode_buckets: tuple[int, ...] = (1, 2, 4, 8),
+                 prompt_buckets: tuple[int, ...] = (16, 32, 64),
+                 max_model_len: int | None = None,
+                 attn_impl: str = "auto",
+                 telemetry=None, metrics=None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.module = module
+        self.params = params
+        self.spec = spec
+        self.decode_buckets = tuple(sorted(decode_buckets))
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        model_cap = getattr(module, "max_seq_len", None) or spec.max_len
+        self.max_model_len = min(max_model_len or model_cap, model_cap,
+                                 spec.max_len)
+        if self.prompt_buckets[-1] > self.max_model_len:
+            raise ValueError(
+                f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"max_model_len {self.max_model_len}")
+        self.attn_impl = attn_impl
+        self.telemetry = telemetry
+        self.metrics = metrics
+        self._clock = clock
+        self.table_width = pages_for_tokens(self.max_model_len,
+                                            spec.page_size)
+
+        self.pool = PagePool(spec.num_pages)
+        self.cache = kv_cache.init_cache(spec)
+        self.waiting: collections.deque[Request] = collections.deque()
+        max_b = self.decode_buckets[-1]
+        self.slots: list[Request | None] = [None] * max_b
+        # Host mirrors of per-slot device state.
+        self._tables = np.zeros((max_b, self.table_width), np.int32)
+        self._lens = np.zeros(max_b, np.int32)
+        self._next_tok = np.zeros(max_b, np.int32)
+        self.completed: list[Request] = []
+        self.stats = {"compiles": 0, "prefills": 0, "decode_steps": 0,
+                      "tokens_generated": 0, "evictions": 0, "admitted": 0}
+        self._compiled: dict[tuple, Any] = {}
+        self._t0 = self._clock()
+
+    # ---------------------------------------------------------------- steps
+
+    def _decode_fn(self):
+        spec = self.spec
+
+        def run(params, cache, tokens, positions, page_table, last_index):
+            logits, vs = self.module.apply(
+                {"params": params, "cache": cache}, tokens, train=False,
+                decode_ctx=dict(positions=positions, page_table=page_table,
+                                cache_spec=(spec.num_pages, spec.page_size),
+                                last_index=last_index,
+                                attn_impl=self.attn_impl),
+                mutable=["cache"])
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    vs["cache"])
+
+        return run
+
+    def _abstract(self, tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+            tree)
+
+    def _get_step(self, kind: str, batch: int, seq: int):
+        """AOT-compiled executable for one (kind, batch, seq) shape. Every
+        compile goes through here so ``stats["compiles"]`` is the single
+        source of truth the no-recompile test asserts on."""
+        key = (kind, batch, seq)
+        if key not in self._compiled:
+            fn = jax.jit(self._decode_fn(), donate_argnums=1)
+            args = (
+                self._abstract(self.params), self._abstract(self.cache),
+                jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+                jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+                jax.ShapeDtypeStruct((batch, self.table_width), jnp.int32),
+                jax.ShapeDtypeStruct((batch,), jnp.int32),
+            )
+            self._compiled[key] = fn.lower(*args).compile()
+            self.stats["compiles"] += 1
+        return self._compiled[key]
+
+    def warmup(self) -> int:
+        """Precompile every decode bucket and every batch-1 prefill bucket;
+        returns the number of executables. After this, steady-state
+        continuous batching runs entirely out of ``_compiled``."""
+        for b in self.decode_buckets:
+            self._get_step("decode", b, 1)
+        for sp in self.prompt_buckets:
+            self._get_step("prefill", 1, sp)
+        return len(self._compiled)
+
+    # ------------------------------------------------------------ scheduling
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.num_active > 0
+
+    def submit(self, req: Request) -> None:
+        if len(req.prompt) > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt of {len(req.prompt)} tokens exceeds largest "
+                f"prompt bucket {self.prompt_buckets[-1]}")
+        req.submit_t = self._clock()
+        self.waiting.append(req)
+
+    def _free_slot(self) -> int | None:
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self) -> list[int]:
+        """Move waiting requests into free slots while pages last; prefill
+        each (batch-1, prompt-bucket shape). Returns admitted slot ids."""
+        admitted = []
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            need = pages_for_tokens(len(req.prompt) + 1, self.spec.page_size)
+            if not self.pool.can_alloc(need):
+                break
+            self.waiting.popleft()
+            pages = self.pool.alloc(req.request_id, need)
+            self.slots[slot] = req
+            self._tables[slot] = 0
+            self._tables[slot, :need] = pages
+            self._lens[slot] = len(req.prompt)
+            self.stats["admitted"] += 1
+            self._prefill(slot, req)
+            admitted.append(slot)
+        return admitted
+
+    def _prefill(self, slot: int, req: Request) -> None:
+        plen = len(req.prompt)
+        sp = _bucket(plen, self.prompt_buckets)
+        step = self._get_step("prefill", 1, sp)
+        tokens = np.zeros((1, sp), np.int32)
+        tokens[0, :plen] = req.prompt
+        positions = np.arange(sp, dtype=np.int32)[None]
+        table = self._tables[slot:slot + 1]
+        last = np.asarray([plen - 1], np.int32)
+        with self._span("prefill"):
+            tok, self.cache = step(self.params, self.cache,
+                                   jnp.asarray(tokens), jnp.asarray(positions),
+                                   jnp.asarray(table), jnp.asarray(last))
+            first = int(np.asarray(tok)[0])
+        now = self._clock()
+        req.generated.append(first)
+        req.first_token_t = now
+        req.token_times.append(now)
+        self._next_tok[slot] = first
+        self.stats["prefills"] += 1
+        self.stats["tokens_generated"] += 1
+        self._retire(slot)
+
+    def _ensure_pages(self) -> None:
+        """Every active slot must own the page its NEXT append lands in;
+        allocate incrementally, evicting the youngest request on OOM."""
+        while True:
+            need_slot = None
+            for i, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                pos = int(self._lens[i])  # next token's position
+                page_idx = pos // self.spec.page_size
+                owned = len(self.pool.owned(req.request_id))
+                if page_idx >= owned:
+                    need_slot = i
+                    break
+            if need_slot is None:
+                return
+            req = self.slots[need_slot]
+            if self.pool.can_alloc(1):
+                (page,) = self.pool.alloc(req.request_id, 1)
+                owned = len(self.pool.owned(req.request_id))
+                self._tables[need_slot, owned - 1] = page
+                continue
+            self._evict()
+
+    def _evict(self) -> None:
+        """Free the youngest active request and requeue it (recompute on
+        readmission). Raises if nothing is evictable — the pool is too
+        small for even one request, a configuration error."""
+        youngest, slot = None, None
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if youngest is None or req.submit_t > youngest.submit_t:
+                youngest, slot = req, i
+        if youngest is None:
+            raise MemoryError("page pool exhausted with no active request "
+                              "to evict — num_pages is too small")
+        self.pool.free(youngest.request_id)
+        self.slots[slot] = None
+        self._lens[slot] = 0
+        self._tables[slot] = 0
+        youngest.generated.clear()
+        youngest.token_times.clear()
+        youngest.first_token_t = None
+        youngest.evictions += 1
+        self.stats["evictions"] += 1
+        self.waiting.appendleft(youngest)
+
+    def _retire(self, slot: int) -> None:
+        req = self.slots[slot]
+        if req is not None and req.finished(self.max_model_len):
+            self.pool.free(req.request_id)
+            self.slots[slot] = None
+            self._lens[slot] = 0
+            self._tables[slot] = 0
+            self.completed.append(req)
+
+    def _span(self, name: str):
+        if self.telemetry is not None:
+            return self.telemetry.span(name)
+        return contextlib.nullcontext()
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> int:
+        """One scheduling iteration: admit+prefill, then one decode step
+        over the active slots (padded to a batch bucket). Returns tokens
+        generated this iteration."""
+        self._admit()
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        produced = 0
+        if active:
+            self._ensure_pages()
+            active = [i for i, r in enumerate(self.slots) if r is not None]
+        if active:
+            bucket = _bucket(len(active), self.decode_buckets)
+            rows = active + [i for i in range(len(self.slots))
+                             if i not in active][:bucket - len(active)]
+            rows = rows[:bucket]
+            tokens = self._next_tok[rows][:, None].copy()
+            positions = self._lens[rows][:, None].copy()
+            table = self._tables[rows].copy()
+            # Inactive filler rows: scratch page table, position 0, token 0.
+            for j, i in enumerate(rows):
+                if self.slots[i] is None:
+                    tokens[j] = 0
+                    positions[j] = 0
+                    table[j] = 0
+            step = self._get_step("decode", bucket, 1)
+            with self._span("step"):
+                tok, self.cache = step(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(table),
+                    np.zeros(bucket, np.int32))
+                out = np.asarray(tok)
+            now = self._clock()
+            self.stats["decode_steps"] += 1
+            for j, i in enumerate(rows):
+                req = self.slots[i]
+                if req is None:
+                    continue
+                req.generated.append(int(out[j]))
+                req.token_times.append(now)
+                self._lens[i] += 1
+                self._next_tok[i] = int(out[j])
+                produced += 1
+                self._retire(i)
+            self.stats["tokens_generated"] += produced
+        self._export_metrics()
+        return produced
+
+    def run(self, max_steps: int = 100000) -> list[Request]:
+        """Drain every submitted request; returns the completed list."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"engine did not drain in {max_steps} "
+                                   "steps (stop conditions broken?)")
+        return self.completed
+
+    def _export_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        elapsed = max(self._clock() - self._t0, 1e-9)
+        self.metrics.update(
+            serve_active=self.num_active,
+            serve_waiting=len(self.waiting),
+            serve_completed=len(self.completed),
+            serve_tokens_total=self.stats["tokens_generated"],
+            serve_tokens_per_s=self.stats["tokens_generated"] / elapsed,
+            serve_pages_free=self.pool.num_free,
+            serve_evictions=self.stats["evictions"],
+            serve_compiles=self.stats["compiles"],
+            serve_decode_steps=self.stats["decode_steps"],
+        )

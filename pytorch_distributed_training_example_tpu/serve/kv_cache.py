@@ -1,0 +1,164 @@
+"""Paged KV cache: fixed-size pages in a preallocated pool.
+
+The cache for one attention layer is a pair of pools shaped
+``[num_pages, page_size, num_kv_heads, head_dim]``. A request owns a
+*page table* — a row of physical page ids, one per ``page_size`` logical
+tokens — so its K/V live scattered across the pool and the pool never
+fragments: any free page serves any request (SURVEY.md's serving gap,
+ROADMAP item 2; the layout is vLLM's PagedAttention applied to the r6
+``ONLINE_BLOCK_TABLE`` block-indexing machinery). GQA keeps only
+``num_kv_heads`` KV heads per page (4:1 on the bench trunk), which cuts
+cache bytes by the same ratio versus MHA.
+
+Split of responsibilities:
+
+- ``PagePool`` is the HOST-side allocator (plain python free list). It
+  never touches device memory — it hands out integer page ids that the
+  engine writes into page-table rows between decode steps.
+- ``append_pages`` / ``gather_pages`` are the DEVICE-side functional ops
+  traced into the prefill/decode steps. They are pure (functional
+  update; the engine donates the pools so XLA updates in place).
+
+Page 0 is RESERVED as a scratch page: padded (inactive) batch rows point
+their entire page table at it, so their appends land somewhere harmless
+and their reads are masked by position anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+RESERVED_PAGES = 1  # page 0: scratch target for padded batch rows
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheSpec:
+    """Static geometry of one model's paged cache."""
+
+    num_layers: int
+    num_pages: int          # pool size, INCLUDING the reserved scratch page
+    page_size: int          # tokens per page
+    num_kv_heads: int
+    head_dim: int
+    dtype: Any = jnp.float32
+
+    @property
+    def max_len(self) -> int:
+        """Upper bound on any single sequence (pool capacity aside)."""
+        return (self.num_pages - RESERVED_PAGES) * self.page_size
+
+    @property
+    def bytes_per_page(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        # K and V pools, every layer.
+        return (2 * self.num_layers * self.page_size * self.num_kv_heads
+                * self.head_dim * itemsize)
+
+    def layer_shape(self) -> tuple[int, int, int, int]:
+        return (self.num_pages, self.page_size, self.num_kv_heads,
+                self.head_dim)
+
+
+def pages_for_tokens(num_tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``num_tokens`` logical positions."""
+    return -(-max(num_tokens, 1) // page_size)
+
+
+class PagePool:
+    """Host-side free list over physical page ids ``[RESERVED, num_pages)``.
+
+    LIFO reuse keeps recently-freed pages hot; determinism matters more
+    than locality here — same admission order, same page tables, so
+    same-seed serve runs are bit-reproducible.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages <= RESERVED_PAGES:
+            raise ValueError(
+                f"num_pages={num_pages} leaves no allocatable pages "
+                f"({RESERVED_PAGES} reserved)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, RESERVED_PAGES - 1,
+                                           -1))
+        self._owned: dict[str, list[int]] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, request_id: str, n: int) -> list[int]:
+        """Take ``n`` pages for ``request_id``; raises if short (callers
+        check ``can_alloc`` first — admission control, not exceptions,
+        decides who runs)."""
+        if n > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n}, have {len(self._free)}")
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(request_id, []).extend(pages)
+        return pages
+
+    def free(self, request_id: str) -> int:
+        """Return every page owned by ``request_id``; idempotent."""
+        pages = self._owned.pop(request_id, [])
+        self._free.extend(reversed(pages))
+        return len(pages)
+
+    def owned(self, request_id: str) -> list[int]:
+        return list(self._owned.get(request_id, ()))
+
+
+def init_cache(spec: CacheSpec) -> dict:
+    """Zeroed K/V pools for every layer, keyed like the flax ``cache``
+    collection the model's decode path declares (``block_i/attn``)."""
+    shape = spec.layer_shape()
+    return {
+        f"block_{i}": {"attn": {
+            "k_pages": jnp.zeros(shape, spec.dtype),
+            "v_pages": jnp.zeros(shape, spec.dtype),
+        }}
+        for i in range(spec.num_layers)
+    }
+
+
+def append_pages(pages: jax.Array, new: jax.Array, page_table: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+    """Scatter ``new`` K or V rows into the pool through the page table.
+
+    pages:      [P, page_size, Hkv, D] pool (donated by the engine step)
+    new:        [B, S, Hkv, D] freshly-projected K or V
+    page_table: [B, max_pages] int32 physical page per logical block
+    positions:  [B, S] int32 logical position of each new token
+
+    Token (b, s) lands in page ``page_table[b, positions // page_size]``
+    at slot ``positions % page_size``. Padded rows carry page tables full
+    of the scratch page, so their writes collide harmlessly on page 0.
+    """
+    B, S, Hkv, D = new.shape
+    page_size = pages.shape[1]
+    page_ids = jnp.take_along_axis(page_table,
+                                   positions // page_size, axis=1)  # [B, S]
+    slots = positions % page_size
+    flat_new = new.reshape(B * S, Hkv, D).astype(pages.dtype)
+    return pages.at[page_ids.reshape(-1), slots.reshape(-1)].set(
+        flat_new, mode="drop")
+
+
+def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Materialize each request's logical K/V view from the pool.
+
+    Returns [B, max_pages * page_size, Hkv, D]; positions past a
+    request's length hold stale pool contents and MUST be masked by the
+    caller (attention masks on position). This is the XLA decode path —
+    the Pallas kernel reads pages in place instead.
+    """
+    B, max_pages = page_table.shape
+    _, page_size, Hkv, D = pages.shape
+    gathered = jnp.take(pages, page_table.reshape(-1), axis=0)
+    return gathered.reshape(B, max_pages * page_size, Hkv, D)

@@ -1,0 +1,107 @@
+"""CLI serving session behind ``main.py --serve``.
+
+Builds the (decode-capable) model from the training Config, restores
+parameters only — ``Checkpointer.restore_params``, skipping the optimizer
+state that dominates checkpoint bytes — and drains a seeded synthetic
+request stream through the continuous-batching engine, printing a JSON
+summary. The same Config fields that describe the training run (model,
+precision, seq_len, seed, metrics_port) describe the serving one, so a
+checkpoint trained by ``main.py`` serves with the identical flags plus
+``--serve --resume``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main(cfg) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_example_tpu.core import (
+        checkpoint as ckpt_lib)
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve import (
+        engine as engine_lib, loadgen)
+
+    dtype = jnp.float32 if cfg.precision == "fp32" else jnp.bfloat16
+    bundle = registry.create_model(cfg.model, seq_len=cfg.seq_len,
+                                   dtype=dtype, param_dtype=dtype)
+    module = bundle.module
+    if not hasattr(module, "num_kv_heads"):
+        raise SystemExit(f"--serve needs a decode-capable LM, "
+                         f"got --model {cfg.model}")
+
+    params = module.init(jax.random.PRNGKey(cfg.seed),
+                         jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    restored_step = None
+    if cfg.resume:
+        directory = cfg.checkpoint_dir if cfg.resume == "auto" else cfg.resume
+        if not directory:
+            raise SystemExit("--serve --resume auto needs --checkpoint-dir")
+        ck = ckpt_lib.Checkpointer(directory)
+        params, _ = ck.restore_params(params)
+        restored_step = ck.last_restored_step
+
+    metrics = None
+    if cfg.metrics_port is not None:
+        from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+        metrics = fleetobs.MetricsServer(port=cfg.metrics_port).start()
+
+    spec = engine_lib.spec_for_module(module, num_pages=cfg.serve_num_pages,
+                                      page_size=cfg.serve_page_size)
+    buckets = lambda s: tuple(int(t) for t in s.split(",") if t)
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec,
+        decode_buckets=buckets(cfg.serve_decode_buckets),
+        prompt_buckets=buckets(cfg.serve_prompt_buckets),
+        max_model_len=cfg.serve_max_model_len or None, metrics=metrics)
+    eng.warmup()
+
+    # The synthetic stream must fit what the engine was warmed for: prompts
+    # no longer than the largest prompt bucket, prompt + new tokens within
+    # the model-length budget.
+    plen_cap = max(buckets(cfg.serve_prompt_buckets))
+    len_budget = (cfg.serve_max_model_len or module.max_seq_len) - plen_cap
+    defaults = loadgen.LoadSpec()
+    requests = loadgen.generate_requests(loadgen.LoadSpec(
+        num_requests=cfg.serve_requests, rate=cfg.serve_rate,
+        prompt_len_min=min(defaults.prompt_len_min, plen_cap),
+        prompt_len_max=min(defaults.prompt_len_max, plen_cap),
+        max_new_min=max(1, min(defaults.max_new_min, len_budget)),
+        max_new_max=max(1, min(defaults.max_new_max, len_budget)),
+        vocab_size=int(module.vocab_size), seed=cfg.seed))
+    driver = loadgen.OpenLoopDriver(requests)
+    t0 = time.perf_counter()
+    while driver.remaining or eng.has_work:
+        driver.pump(eng, time.perf_counter() - t0)
+        if eng.has_work:
+            eng.step()
+        else:
+            time.sleep(0.0005)
+    wall = time.perf_counter() - t0
+
+    ttfts = sorted(r.ttft_s for r in eng.completed if r.ttft_s is not None)
+    result = {
+        "mode": "serve",
+        "model": cfg.model,
+        "restored_step": restored_step,
+        "requests_completed": len(eng.completed),
+        "tokens_generated": eng.stats["tokens_generated"],
+        "tokens_per_s": round(eng.stats["tokens_generated"]
+                              / max(wall, 1e-9), 2),
+        "ttft_p50_ms": (round(1e3 * float(np.percentile(ttfts, 50)), 3)
+                        if ttfts else None),
+        "compiles": eng.stats["compiles"],
+        "decode_steps": eng.stats["decode_steps"],
+        "evictions": eng.stats["evictions"],
+        "metrics_port": metrics.port if metrics is not None else None,
+    }
+    if metrics is not None:
+        metrics.stop()
+    print(json.dumps(result, indent=2))
+    return result

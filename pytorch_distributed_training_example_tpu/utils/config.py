@@ -129,6 +129,18 @@ class Config:
     fault_inject: str | None = None
     # loop control (bench/smoke)
     steps_per_epoch: int | None = None  # cap steps (synthetic/bench runs)
+    # serving (serve/): main.py --serve runs the continuous-batching decode
+    # engine over a paged KV cache instead of training. Restores params only
+    # (Checkpointer.restore_params) when --resume is set. Bucket lists are
+    # comma-separated ints; max_model_len 0 means the model/cache cap.
+    serve: bool = False
+    serve_page_size: int = 16
+    serve_num_pages: int = 128
+    serve_max_model_len: int = 0
+    serve_decode_buckets: str = "1,2,4,8"
+    serve_prompt_buckets: str = "16,32"
+    serve_requests: int = 16
+    serve_rate: float = 0.0  # open-loop req/s; 0 = all at t=0 (saturation)
 
     def mesh_config(self) -> dict[str, int]:
         return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,

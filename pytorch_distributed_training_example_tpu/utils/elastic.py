@@ -69,6 +69,13 @@ POLICIES = (KEEP_GLOBAL_BATCH, SCALE_LR)
 #: dying attempt and read by the supervisor before relaunch.
 DEAD_HOSTS_FILE = "dead_hosts.jsonl"
 
+#: The grow-side mirror: one JSON line per host COMING BACK (repaired, or a
+#: preemption ending), appended by whoever notices — a node manager, a health
+#: probe, the returning host itself. The supervisor reads both files and
+#: relaunches at ``base_world - |currently dead|``, so a return grows the
+#: world back (bounded by ``--elastic MIN[:MAX]``'s MAX and the base size).
+RETURNED_HOSTS_FILE = "returned_hosts.jsonl"
+
 
 @dataclasses.dataclass(frozen=True)
 class BatchPlan:
@@ -200,24 +207,23 @@ def recorded_world(recorded: dict) -> int | None:
 # ---------------------------------------------------------------------------
 
 
-def record_dead_host(directory: str, host: int, *, world: int | None = None,
-                     step: int | None = None, reason: str = "") -> str:
-    """Append one dead-host record; returns the file path. Append-only and
-    line-atomic (one ``write`` call) so a dying process can't corrupt it."""
-    path = os.path.join(directory, DEAD_HOSTS_FILE)
+def _record_host_event(directory: str, filename: str, host: int, *,
+                       world: int | None, step: int | None,
+                       reason: str) -> str:
+    path = os.path.join(directory, filename)
     row = {"host": int(host), "world": world, "step": step, "reason": reason}
     with open(path, "a") as fh:
         fh.write(json.dumps(row) + "\n")
     return path
 
 
-def read_dead_hosts(directory: str) -> set[int]:
-    """Unique host ids recorded dead under ``directory`` (empty if no file).
-    Unparseable lines (a host died mid-``write`` despite line-atomicity,
-    filesystem truncation) are skipped — a lost record degrades to a
-    same-size relaunch, never a crash."""
-    path = os.path.join(directory, DEAD_HOSTS_FILE)
-    hosts: set[int] = set()
+def _read_host_counts(directory: str, filename: str) -> dict[int, int]:
+    """host id -> number of recorded events (empty if no file). Unparseable
+    lines (a host died mid-``write`` despite line-atomicity, filesystem
+    truncation) are skipped — a lost record degrades to a same-size
+    relaunch, never a crash."""
+    path = os.path.join(directory, filename)
+    counts: dict[int, int] = {}
     try:
         with open(path) as fh:
             for line in fh:
@@ -225,9 +231,45 @@ def read_dead_hosts(directory: str) -> set[int]:
                 if not line:
                     continue
                 try:
-                    hosts.add(int(json.loads(line)["host"]))
+                    host = int(json.loads(line)["host"])
                 except (ValueError, KeyError, TypeError):
                     continue
+                counts[host] = counts.get(host, 0) + 1
     except FileNotFoundError:
         pass
-    return hosts
+    return counts
+
+
+def record_dead_host(directory: str, host: int, *, world: int | None = None,
+                     step: int | None = None, reason: str = "") -> str:
+    """Append one dead-host record; returns the file path. Append-only and
+    line-atomic (one ``write`` call) so a dying process can't corrupt it."""
+    return _record_host_event(directory, DEAD_HOSTS_FILE, host, world=world,
+                              step=step, reason=reason)
+
+
+def record_host_return(directory: str, host: int, *, world: int | None = None,
+                       step: int | None = None, reason: str = "") -> str:
+    """Append one host-return record (the grow-side mirror of
+    :func:`record_dead_host`); returns the file path."""
+    return _record_host_event(directory, RETURNED_HOSTS_FILE, host,
+                              world=world, step=step, reason=reason)
+
+
+def read_dead_hosts(directory: str) -> set[int]:
+    """Unique host ids EVER recorded dead under ``directory``."""
+    return set(_read_host_counts(directory, DEAD_HOSTS_FILE))
+
+
+def read_returned_hosts(directory: str) -> set[int]:
+    """Unique host ids ever recorded as returned under ``directory``."""
+    return set(_read_host_counts(directory, RETURNED_HOSTS_FILE))
+
+
+def effective_dead_hosts(directory: str) -> set[int]:
+    """Hosts dead RIGHT NOW: recorded dead strictly more times than
+    returned. Count-based (not set difference) so a host that dies, returns
+    and dies again is correctly dead — both files are append-only logs."""
+    dead = _read_host_counts(directory, DEAD_HOSTS_FILE)
+    ret = _read_host_counts(directory, RETURNED_HOSTS_FILE)
+    return {h for h, c in dead.items() if c > ret.get(h, 0)}

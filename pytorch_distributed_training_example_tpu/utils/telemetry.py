@@ -44,8 +44,12 @@ from pytorch_distributed_training_example_tpu.utils import fleetobs
 
 log = logging.getLogger("pdtx")
 
-#: Span names treated as productive time in the goodput summary.
-PRODUCTIVE_SPANS = ("step",)
+#: Span names treated as productive time in the goodput summary. "step" is
+#: the training step AND the serving decode step; "prefill" is the serving
+#: engine's prompt-ingestion forward (serve/engine.py) — tokens leave the
+#: model in both, so both count toward goodput. Trainers never emit
+#: "prefill", so training goodput is unchanged.
+PRODUCTIVE_SPANS = ("step", "prefill")
 
 #: Badput categories the trainer emits (order is the report order).
 #: "restart" is synthesized, not timed by a span: the wall-clock gap between

@@ -189,6 +189,22 @@ def test_dead_hosts_round_trip_tolerates_corruption(tmp_path):
     assert rows[0] == {"host": 1, "world": 2, "step": 5, "reason": "test"}
 
 
+def test_returned_hosts_cancel_dead_records(tmp_path):
+    d = str(tmp_path)
+    assert elastic.effective_dead_hosts(d) == set()
+    elastic.record_dead_host(d, 1, world=2, reason="kill")
+    elastic.record_dead_host(d, 3, world=2, reason="kill")
+    assert elastic.effective_dead_hosts(d) == {1, 3}
+    elastic.record_host_return(d, 1, reason="repaired")
+    assert elastic.read_returned_hosts(d) == {1}
+    assert elastic.effective_dead_hosts(d) == {3}
+    # Count-based, not set difference: die -> return -> die again is dead.
+    elastic.record_dead_host(d, 1, world=2, reason="kill again")
+    assert elastic.effective_dead_hosts(d) == {1, 3}
+    # read_dead_hosts keeps its historical "ever died" semantics.
+    assert elastic.read_dead_hosts(d) == {1, 3}
+
+
 # ---------------------------------------------------------------------------
 # mesh: elastic_resolve degrades pinned axes instead of refusing
 # ---------------------------------------------------------------------------
@@ -290,6 +306,53 @@ def test_supervisor_shrinks_world_after_host_loss(tmp_path):
     world, argv = (ckdir / "resumed.txt").read_text().split("|", 1)
     assert world == "1"  # relaunched one host smaller
     assert "--resume auto" in argv
+
+
+def _write_grow_script(tmp_path):
+    """Fake gang member for the shrink-then-grow drill. Attempt 1 (world 2):
+    the highest rank records itself dead and dies abruptly. Attempt 2 (world
+    1): the survivor records the host's RETURN and exits preempted. Attempt
+    3 must therefore come back at world 2; rank 0 writes the final marker."""
+    script = tmp_path / "fake_grow_job.py"
+    script.write_text(
+        "import json, os, sys, time\n"
+        "args = sys.argv[1:]\n"
+        "ckdir = args[args.index('--checkpoint-dir') + 1]\n"
+        "os.makedirs(ckdir, exist_ok=True)\n"
+        "rank = int(os.environ.get('PROCESS_ID', '0'))\n"
+        "world = int(os.environ.get('NUM_PROCESSES', '1'))\n"
+        "returned = os.path.exists(os.path.join(ckdir, 'returned.txt'))\n"
+        "if world > 1 and not returned:\n"  # attempt 1: lose the last host
+        "    if rank == world - 1:\n"
+        "        with open(os.path.join(ckdir, 'dead_hosts.jsonl'), 'a') as fh:\n"
+        "            fh.write(json.dumps({'host': rank, 'world': world}) + '\\n')\n"
+        "        os._exit(76)\n"
+        "    time.sleep(30)\n"
+        "    sys.exit(1)\n"
+        "if world == 1:\n"  # attempt 2: the lost host came back repaired
+        "    with open(os.path.join(ckdir, 'returned.txt'), 'w') as fh:\n"
+        "        fh.write('1')\n"
+        "    with open(os.path.join(ckdir, 'returned_hosts.jsonl'), 'a') as fh:\n"
+        "        fh.write(json.dumps({'host': 1, 'reason': 'repaired'}) + '\\n')\n"
+        "    sys.exit(75)\n"
+        "with open(os.path.join(ckdir, f'final.r{rank}.txt'), 'w') as fh:\n"
+        "    fh.write(str(world) + '|' + ' '.join(args))\n"
+        "sys.exit(0)\n")
+    return script
+
+
+def test_supervisor_grows_world_on_host_return(tmp_path):
+    script = _write_grow_script(tmp_path)
+    res, ckdir = _run_launch(tmp_path, script, "--elastic", "1")
+    assert res.returncode == 0, res.stderr
+    assert "elastic — host(s) [1] lost, relaunching at world size 1" \
+        in res.stderr, res.stderr
+    assert "elastic — host(s) [1] returned, relaunching at world size 2" \
+        in res.stderr, res.stderr
+    world, argv = (ckdir / "final.r0.txt").read_text().split("|", 1)
+    assert world == "2"  # grew back to the launch-time size
+    assert "--resume auto" in argv
+    assert (ckdir / "final.r1.txt").exists()  # the returned host ran again
 
 
 def test_supervisor_gives_up_below_elastic_min(tmp_path):
