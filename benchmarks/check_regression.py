@@ -98,7 +98,8 @@ def check(result: dict, golden: dict, tolerance: float = 0.10):
     return failures, report
 
 
-def check_goodput(path: str, min_coverage: float = 0.95):
+def check_goodput(path: str, min_coverage: float = 0.95,
+                  cluster: bool = False):
     """Gate a run's ``goodput.json`` on instrumentation coverage.
 
     Accepts both single-attempt files and the merged multi-attempt files an
@@ -107,6 +108,13 @@ def check_goodput(path: str, min_coverage: float = 0.95):
     ``coverage`` — spans must explain at least ``min_coverage`` of the total
     wall clock across every attempt, so a restart tax that the telemetry
     failed to attribute shows up as a failure rather than vanishing.
+
+    With ``cluster=True`` the input is a fleet launcher's
+    ``cluster_goodput.json`` (fleetobs.aggregate_cluster_goodput): an
+    aggregate over independent jobs, where distinct run_ids are the expected
+    shape — the mixed-run refusal below is the *single-run* staleness check
+    and does not apply. The coverage floor is then cluster-wide
+    (wall-weighted across jobs).
     """
     failures, report = [], []
     try:
@@ -121,11 +129,30 @@ def check_goodput(path: str, min_coverage: float = 0.95):
         return failures, report
     attempts = int(data.get("attempts", 1))
     restart_s = float(data.get("categories_s", {}).get("restart", 0.0))
+    run_ids = [r for r in (data.get("run_ids") or []) if r]
+    if cluster:
+        jobs = data.get("jobs") or []
+        if not data.get("cluster"):
+            msg = (f"goodput {path}: --cluster expects a fleet "
+                   "cluster_goodput.json (aggregate_cluster_goodput), got a "
+                   "single-run summary")
+            failures.append(msg)
+            report.append("MALFORMED " + msg)
+            return failures, report
+        line = (f"cluster goodput {path}: coverage {coverage:.3f} over "
+                f"{wall:.1f}s device-wall, {len(jobs)} job(s) "
+                f"{sorted(jobs)}, {len(set(run_ids))} run id(s), "
+                f"{attempts} attempt(s), restart tax {restart_s:.1f}s")
+        if coverage < min_coverage:
+            failures.append(line + f" — below floor {min_coverage}")
+            report.append("REGRESSION " + line + f" (floor {min_coverage})")
+        else:
+            report.append("OK " + line)
+        return failures, report
     # Mixed-run refusal: a cumulative/fleet summary stamped with more than
     # one run id silently sums UNRELATED attempts (stale artifacts in a
     # reused checkpoint dir) — its coverage and goodput are meaningless, so
     # fail loudly instead of gating on fiction.
-    run_ids = [r for r in (data.get("run_ids") or []) if r]
     if len(set(run_ids)) > 1:
         msg = (f"goodput {path}: merged across {len(set(run_ids))} different "
                f"runs {sorted(set(run_ids))} — refusing to gate a mixed-run "
@@ -254,6 +281,12 @@ def main(argv=None):
                         "(cumulative across supervisor attempts for elastic "
                         "runs); fails below --goodput-min-coverage")
     p.add_argument("--goodput-min-coverage", type=float, default=0.95)
+    p.add_argument("--cluster", action="store_true",
+                   help="with --goodput: the file is a fleet "
+                        "cluster_goodput.json (launch.py --fleet) — gate "
+                        "wall-weighted coverage across jobs and accept the "
+                        "distinct per-job run_ids a multi-tenant aggregate "
+                        "carries by construction")
     p.add_argument("--aot-bytes", action="store_true",
                    help="input is a profile_step.py --aot report: gate "
                         "per-region modeled bytes (UP is the regression "
@@ -331,7 +364,8 @@ def main(argv=None):
         report += h_report
     if args.goodput:
         g_failures, g_report = check_goodput(args.goodput,
-                                             args.goodput_min_coverage)
+                                             args.goodput_min_coverage,
+                                             cluster=args.cluster)
         failures += g_failures
         report += g_report
     for line in report:

@@ -443,7 +443,8 @@ def _escape_sink(mod, call, free, launch_calls):
 # GL002: fs ops bypassing retriable_io in checkpoint/resilience paths
 # ---------------------------------------------------------------------------
 
-GL002_PATHS = (f"{PKG}/core/checkpoint.py", f"{PKG}/utils/resilience.py")
+GL002_PATHS = (f"{PKG}/core/checkpoint.py", f"{PKG}/utils/resilience.py",
+               f"{PKG}/utils/scheduler.py", "launch.py")
 _FS_OPS = {
     "open",
     "os.replace",
@@ -753,7 +754,8 @@ def _gl004(root: str) -> list[Finding]:
 # ---------------------------------------------------------------------------
 
 GL005_PATHS = (f"{PKG}/utils/chaos.py", f"{PKG}/data/sampler.py",
-               f"{PKG}/serve/engine.py", f"{PKG}/serve/loadgen.py")
+               f"{PKG}/serve/engine.py", f"{PKG}/serve/loadgen.py",
+               f"{PKG}/utils/scheduler.py", "launch.py")
 _NP_UNSEEDED = {
     "rand",
     "randn",

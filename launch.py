@@ -39,6 +39,7 @@ itself) cancels its dead record, and the next relaunch runs at
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -49,7 +50,7 @@ import time
 try:
     # resilience.py / elastic.py deliberately import no jax — safe here.
     from pytorch_distributed_training_example_tpu.utils.resilience import (
-        HOST_LOST_EXIT_CODE, PREEMPTED_EXIT_CODE)
+        HOST_LOST_EXIT_CODE, PREEMPTED_EXIT_CODE, retriable_io)
     from pytorch_distributed_training_example_tpu.utils.elastic import (
         effective_dead_hosts)
 except ImportError:  # stripped deployments: keep the launcher standalone
@@ -58,6 +59,15 @@ except ImportError:  # stripped deployments: keep the launcher standalone
 
     def effective_dead_hosts(directory):
         return set()
+
+    def retriable_io(fn, *args, _what="io", _attempts=4,
+                     _base_delay_s=0.05, **kwargs):
+        return fn(*args, **kwargs)
+
+
+def _read_json(path):
+    with open(path) as fh:
+        return json.load(fh)
 
 
 def free_port() -> int:
@@ -127,8 +137,9 @@ def run_once(args, cmd) -> int:
         if rank == 0:
             out = err = None
         else:
-            out = err = open(
-                os.path.join(args.log_dir, f"launch_rank{rank}.log"), "w")
+            out = err = retriable_io(
+                open, os.path.join(args.log_dir, f"launch_rank{rank}.log"),
+                "w", _what="rank log open")
         procs.append(subprocess.Popen([sys.executable, *cmd], env=env,
                                       stdout=out, stderr=err))
 
@@ -218,9 +229,26 @@ def main(argv=None):
                         "fleet trace/goodput/straggler report "
                         "(benchmarks/trace_merge.py); auto = when artifacts "
                         "exist")
+    p.add_argument("--fleet", default=None, metavar="JOBS_JSON",
+                   help="multi-job control plane: run the utils/scheduler.py "
+                        "loop over the jobs in JOBS_JSON sharing one device "
+                        "pool — priorities, SIGTERM preemption (exit "
+                        f"{PREEMPTED_EXIT_CODE} requeues without burning the "
+                        "restart budget), doubling backoff, and backfill of "
+                        "devices freed by dead hosts; ignores the "
+                        "single-gang flags")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="with --fleet: serve cluster + per-job pdtx_fleet_* "
+                        "gauges on one /metrics endpoint (0 = ephemeral)")
+    p.add_argument("--fleet-poll", type=float, default=0.05,
+                   help="with --fleet: scheduler loop poll interval seconds")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- script.py args...")
     args = p.parse_args(argv)
+    if args.fleet is not None:
+        retriable_io(os.makedirs, args.log_dir, exist_ok=True,
+                     _what="log dir create")
+        return run_fleet(args)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         p.error("no command given; usage: launch.py --nprocs N -- main.py ...")
@@ -233,7 +261,8 @@ def main(argv=None):
             elastic = parse_elastic(args.elastic)
         except ValueError as e:
             p.error(str(e))
-    os.makedirs(args.log_dir, exist_ok=True)
+    retriable_io(os.makedirs, args.log_dir, exist_ok=True,
+                 _what="log dir create")
     code = supervise(args, cmd, elastic)
     if args.trace_merge == "auto":
         # Post-mortem-friendly: the merge runs after EVERY terminal outcome
@@ -251,7 +280,7 @@ def merge_traces(cmd: list[str]) -> None:
     if not ckdir or not os.path.isdir(ckdir):
         return
     try:
-        names = os.listdir(ckdir)
+        names = retriable_io(os.listdir, ckdir, _what="trace merge scan")
     except OSError:
         return
     if not any(n.startswith("trace_events") and n.endswith(".json")
@@ -341,6 +370,166 @@ def supervise(args, cmd, elastic) -> int:
             # argparse last-wins makes appending safe even if a later restart
             # re-appends; guard anyway to keep the command line readable.
             cmd = [*cmd, "--resume", "auto"]
+
+
+def write_cluster_goodput(sched, log_dir: str) -> dict | None:
+    """Fold each job's merged ``goodput.json`` into one cluster summary
+    (``cluster_goodput.json`` in the fleet log dir) — distinct run_ids by
+    construction, which is what ``check_regression.py --goodput --cluster``
+    gates. Best-effort: jobs without telemetry just don't contribute."""
+    from pytorch_distributed_training_example_tpu.utils import fleetobs
+    from pytorch_distributed_training_example_tpu.utils import (
+        scheduler as scheduler_lib)
+
+    per_job = {}
+    for name in sorted(sched.jobs):
+        ckdir = sched.state(name).spec.checkpoint_dir
+        if not ckdir:
+            continue
+        path = os.path.join(ckdir, "goodput.json")
+        if not os.path.exists(path):
+            continue
+        try:
+            per_job[name] = retriable_io(_read_json, path,
+                                         _what="fleet goodput read")
+        except (OSError, ValueError):
+            print(f"launch.py: fleet — unreadable goodput for {name} "
+                  f"({path})", file=sys.stderr)
+    if not per_job:
+        return None
+    cluster = fleetobs.aggregate_cluster_goodput(per_job)
+    fleetobs.write_json_atomic(
+        os.path.join(log_dir, scheduler_lib.CLUSTER_GOODPUT_FILE), cluster)
+    return cluster
+
+
+def run_fleet(args) -> int:
+    """The multi-job control plane: spawn/preempt/relaunch what the
+    scheduler decides, over one shared pool of fake CPU devices.
+
+    Each job runs as one local process whose ``world`` is its fake-device
+    count (the same local-pod shape ``--nprocs 1 --cpu-devices N`` uses and
+    the dryrun drills test); on a real pod the worlds would map to hosts.
+    Preemption is a SIGTERM — the trainer's resilience path takes its
+    emergency checkpoint and exits PREEMPTED_EXIT_CODE, and the scheduler
+    requeues it; relaunches append ``--resume auto``.
+    """
+    from pytorch_distributed_training_example_tpu.utils import fleetobs
+    from pytorch_distributed_training_example_tpu.utils import (
+        scheduler as scheduler_lib)
+
+    pool, specs = scheduler_lib.load_jobs(args.fleet)
+    sched = scheduler_lib.FleetScheduler(pool, specs, log_dir=args.log_dir)
+    print(f"launch.py: fleet — {len(specs)} job(s) over a pool of "
+          f"{pool} device(s)", file=sys.stderr)
+    procs: dict[str, subprocess.Popen] = {}
+    logs: dict[str, object] = {}
+    metrics = None
+    if args.metrics_port is not None:
+        metrics = fleetobs.MetricsServer(port=args.metrics_port).start()
+        print(f"launch.py: fleet metrics on :{metrics.port}", file=sys.stderr)
+
+    def stop_fleet(*_sig):
+        global _interrupted
+        _interrupted = True
+        for pr in procs.values():
+            if pr.poll() is None:
+                pr.terminate()
+
+    signal.signal(signal.SIGINT, stop_fleet)
+    signal.signal(signal.SIGTERM, stop_fleet)
+
+    def spawn(name: str, world: int) -> None:
+        st = sched.state(name)
+        cmd = list(st.spec.cmd)
+        if st.attempts > 1 and "--resume" not in cmd:
+            # argparse last-wins; same relaunch contract as supervise().
+            cmd = [*cmd, "--resume", "auto"]
+        port = coordinator_port(None)
+        env = os.environ.copy()
+        env.update(dict(st.spec.env))
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"], env["PROCESS_ID"] = "1", "0"
+        env["MASTER_ADDR"], env["MASTER_PORT"] = "127.0.0.1", str(port)
+        env["WORLD_SIZE"], env["RANK"] = "1", "0"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORMS_OVERRIDE"] = "cpu"
+        env["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={world}").strip()
+        if name not in logs:
+            logs[name] = retriable_io(
+                open, os.path.join(args.log_dir, f"fleet_{name}.log"), "a",
+                _what="fleet log open")
+        print(f"launch.py: fleet — launch {name} at world {world} "
+              f"(attempt {st.attempts})", file=sys.stderr)
+        procs[name] = subprocess.Popen([sys.executable, *cmd], env=env,
+                                       stdout=logs[name], stderr=logs[name])
+
+    while not _interrupted:
+        for name, pr in list(procs.items()):
+            rc = pr.poll()
+            if rc is not None:
+                procs.pop(name)
+                row = sched.on_exit(name, rc, time.monotonic())
+                print(f"launch.py: fleet — {name} exited {rc}: "
+                      f"{row['reason']}", file=sys.stderr)
+        now = time.monotonic()
+        decisions = sched.plan(now)
+        for d in decisions:
+            if d["action"] == "launch":
+                spawn(d["job"], d["world"])
+            elif d["action"] == "preempt":
+                print(f"launch.py: fleet — preempt {d['job']}: "
+                      f"{d['reason']}", file=sys.stderr)
+                pr = procs.get(d["job"])
+                if pr is not None and pr.poll() is None:
+                    pr.send_signal(signal.SIGTERM)
+        if metrics is not None:
+            metrics.update(**sched.gauges())
+        if sched.finished():
+            break
+        deadline = sched.next_deadline_s()
+        if (not procs and not decisions
+                and (deadline is None or deadline <= now)):
+            # Whole pool free, every backoff expired, still nothing
+            # placeable — the leftovers are permanently stuck (dependency
+            # died checkpoint-less, or dead hosts pinned a range shut).
+            for row in sched.mark_starved():
+                print(f"launch.py: fleet — give up on {row['job']}: "
+                      f"{row['reason']}", file=sys.stderr)
+            break
+        time.sleep(args.fleet_poll)
+
+    for pr in procs.values():
+        if pr.poll() is None:
+            pr.terminate()
+    for pr in procs.values():
+        try:
+            pr.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+    for fh in logs.values():
+        fh.close()
+    cluster = write_cluster_goodput(sched, args.log_dir)
+    if cluster:
+        print(f"launch.py: fleet — cluster goodput "
+              f"{cluster.get('goodput_fraction')} coverage "
+              f"{cluster.get('coverage')} over {len(cluster.get('jobs', []))}"
+              f" job(s), {cluster.get('attempts')} attempt(s)",
+              file=sys.stderr)
+        if metrics is not None:
+            metrics.update(
+                fleet_goodput_fraction=cluster.get("goodput_fraction") or 0.0,
+                fleet_goodput_coverage=cluster.get("coverage") or 0.0)
+    states = {name: sched.state(name).status for name in sorted(sched.jobs)}
+    print(f"launch.py: fleet — final states {states}", file=sys.stderr)
+    if metrics is not None:
+        metrics.update(**sched.gauges())
+        metrics.stop()
+    if _interrupted:
+        return 130
+    return 0 if all(s == scheduler_lib.DONE for s in states.values()) else 1
 
 
 if __name__ == "__main__":

@@ -217,6 +217,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-rate", type=float, default=None, dest="serve_rate",
                    help="open-loop Poisson arrival rate (req/s); 0 = all at "
                         "t=0 (saturation)")
+    p.add_argument("--serve-drain-timeout", type=float, default=None,
+                   dest="serve_drain_timeout",
+                   help="on SIGTERM, seconds to let in-flight sequences "
+                        "finish decoding before exiting 75 (graceful "
+                        "preemption of a serving session)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,

@@ -326,11 +326,14 @@ class ContinuousBatchingEngine:
 
     # ---------------------------------------------------------------- step
 
-    def step(self) -> int:
+    def step(self, admit: bool = True) -> int:
         """One scheduling iteration: admit+prefill, then one decode step
         over the active slots (padded to a batch bucket). Returns tokens
-        generated this iteration."""
-        self._admit()
+        generated this iteration. ``admit=False`` is the drain mode a
+        graceful shutdown uses: in-flight sequences keep decoding to
+        completion but nothing moves from the waiting queue into a slot."""
+        if admit:
+            self._admit()
         active = [i for i, r in enumerate(self.slots) if r is not None]
         produced = 0
         if active:

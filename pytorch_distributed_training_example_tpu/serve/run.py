@@ -15,6 +15,40 @@ from __future__ import annotations
 import json
 import time
 
+from pytorch_distributed_training_example_tpu.utils import resilience
+
+
+def serve_loop(driver, eng, drain_timeout_s: float = 5.0) -> dict:
+    """Drive the open-loop stream until drained — or gracefully shut down.
+
+    When a SIGTERM lands (``resilience.preempted()``, handler installed by
+    :func:`main`), the loop stops pumping new requests and *drains*: active
+    slots keep decoding to completion via ``eng.step(admit=False)``, bounded
+    by ``drain_timeout_s``, instead of dying mid-decode-step. This is the
+    serving counterpart of the trainer's checkpoint-and-yield path — finish
+    the in-flight work, then exit ``PREEMPTED_EXIT_CODE`` — which is what
+    makes serving jobs preemptible by the fleet scheduler
+    (``launch.py --fleet``) with nothing worse than truncated tail latency.
+    """
+    t0 = time.perf_counter()
+    drain_deadline = None
+    while driver.remaining or eng.has_work:
+        if drain_deadline is None and resilience.preempted():
+            drain_deadline = time.perf_counter() + drain_timeout_s
+        if drain_deadline is not None:
+            if eng.num_active == 0 or time.perf_counter() >= drain_deadline:
+                break
+            eng.step(admit=False)
+            continue
+        driver.pump(eng, time.perf_counter() - t0)
+        if eng.has_work:
+            eng.step()
+        else:
+            time.sleep(0.0005)
+    return {"wall_s": time.perf_counter() - t0,
+            "preempted": drain_deadline is not None,
+            "drained": eng.num_active == 0}
+
 
 def main(cfg) -> dict:
     import jax
@@ -75,15 +109,14 @@ def main(cfg) -> dict:
         max_new_min=max(1, min(defaults.max_new_min, len_budget)),
         max_new_max=max(1, min(defaults.max_new_max, len_budget)),
         vocab_size=int(module.vocab_size), seed=cfg.seed))
+    # SIGTERM becomes a bounded drain + exit 75 instead of a mid-step death
+    # (the scheduler's preemption contract). Install is idempotent and a
+    # no-op off the main thread (in-process tests drive serve_loop directly).
+    resilience.install()
     driver = loadgen.OpenLoopDriver(requests)
-    t0 = time.perf_counter()
-    while driver.remaining or eng.has_work:
-        driver.pump(eng, time.perf_counter() - t0)
-        if eng.has_work:
-            eng.step()
-        else:
-            time.sleep(0.0005)
-    wall = time.perf_counter() - t0
+    outcome = serve_loop(driver, eng,
+                         drain_timeout_s=cfg.serve_drain_timeout)
+    wall = outcome["wall_s"]
 
     ttfts = sorted(r.ttft_s for r in eng.completed if r.ttft_s is not None)
     result = {
@@ -100,8 +133,12 @@ def main(cfg) -> dict:
         "decode_steps": eng.stats["decode_steps"],
         "evictions": eng.stats["evictions"],
         "metrics_port": metrics.port if metrics is not None else None,
+        "preempted": outcome["preempted"],
+        "drained": outcome["drained"],
     }
     if metrics is not None:
         metrics.stop()
     print(json.dumps(result, indent=2))
+    if outcome["preempted"]:
+        raise resilience.PreemptedExit()
     return result

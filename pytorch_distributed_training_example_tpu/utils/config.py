@@ -141,6 +141,10 @@ class Config:
     serve_prompt_buckets: str = "16,32"
     serve_requests: int = 16
     serve_rate: float = 0.0  # open-loop req/s; 0 = all at t=0 (saturation)
+    # SIGTERM drain budget: in-flight sequences get this many seconds to
+    # finish decoding before the session exits PREEMPTED_EXIT_CODE (the
+    # fleet scheduler's preemption contract for serving jobs).
+    serve_drain_timeout: float = 5.0
 
     def mesh_config(self) -> dict[str, int]:
         return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,

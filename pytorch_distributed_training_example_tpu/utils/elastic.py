@@ -221,7 +221,10 @@ def _read_host_counts(directory: str, filename: str) -> dict[int, int]:
     """host id -> number of recorded events (empty if no file). Unparseable
     lines (a host died mid-``write`` despite line-atomicity, filesystem
     truncation) are skipped — a lost record degrades to a same-size
-    relaunch, never a crash."""
+    relaunch, never a crash. Shared by the dead-host AND returned-host
+    readers, so both sides of the shrink/grow ledger get identical
+    torn-tail tolerance; any OSError (not just a missing file — ESTALE on
+    NFS, EIO mid-read) likewise degrades to "no records seen"."""
     path = os.path.join(directory, filename)
     counts: dict[int, int] = {}
     try:
@@ -235,7 +238,7 @@ def _read_host_counts(directory: str, filename: str) -> dict[int, int]:
                 except (ValueError, KeyError, TypeError):
                     continue
                 counts[host] = counts.get(host, 0) + 1
-    except FileNotFoundError:
+    except OSError:
         pass
     return counts
 

@@ -626,3 +626,61 @@ def aggregate_goodput(per_rank: dict[int, dict]) -> dict:
             "host": (per_rank[r].get("meta") or {}).get("host"),
         } for r in ranks},
     }
+
+
+def aggregate_cluster_goodput(per_job: dict[str, dict]) -> dict:
+    """Fold per-JOB goodput summaries into one cluster-level summary.
+
+    The unit of aggregation is different from :func:`aggregate_goodput`:
+    there the inputs are ranks of ONE run spanning the same wall clock (so
+    category seconds average), here they are independent jobs of a shared
+    device pool — separate runs with *distinct run_ids* and disjoint wall
+    spans. Wall and category seconds therefore SUM (the device-time view a
+    cluster is billed in), coverage and goodput come out wall-weighted, and
+    carrying several run_ids is the expected shape, not the stale-artifact
+    smell it is for a single run (``check_regression.py --goodput
+    --cluster`` relaxes the mixed-run refusal for exactly this file).
+    """
+    names = sorted(per_job)
+    if not names:
+        return {}
+    wall = 0.0
+    cats: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    run_ids: list[str] = []
+    attempts = 0
+    for name in names:
+        g = per_job[name]
+        wall += float(g.get("wall_s") or 0.0)
+        for k, v in (g.get("categories_s") or {}).items():
+            cats[k] = cats.get(k, 0.0) + float(v)
+        for k, v in (g.get("counts") or {}).items():
+            counts[k] = counts.get(k, 0) + int(v)
+        rid = g.get("run_id")
+        if rid and rid not in run_ids:
+            run_ids.append(rid)
+        attempts += int(g.get("attempts") or 1)
+    wall = max(wall, 1e-9)
+    fracs = {k: v / wall for k, v in cats.items()}
+    good = sum(fracs.get(k, 0.0) for k in ("step", "prefill"))
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "cluster": True,
+        "jobs": names,
+        "run_ids": run_ids,
+        "wall_s": round(wall, 4),
+        "categories_s": {k: round(v, 4) for k, v in sorted(cats.items())},
+        "counts": counts,
+        "fractions": {k: round(v, 4) for k, v in sorted(fracs.items())},
+        "goodput_fraction": round(good, 4),
+        "badput_fraction": round(sum(fracs.values()) - good, 4),
+        "coverage": round(sum(fracs.values()), 4),
+        "attempts": attempts,
+        "per_job": {name: {
+            "run_id": per_job[name].get("run_id"),
+            "goodput_fraction": per_job[name].get("goodput_fraction"),
+            "coverage": per_job[name].get("coverage"),
+            "wall_s": per_job[name].get("wall_s"),
+            "attempts": per_job[name].get("attempts"),
+        } for name in names},
+    }

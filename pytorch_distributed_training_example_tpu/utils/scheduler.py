@@ -1,0 +1,489 @@
+"""Multi-tenant fleet scheduler: priorities, preemption, backfill (pure).
+
+``launch.py --fleet jobs.json`` promotes the single-gang supervisor into a
+control plane for N jobs sharing one device pool. All *decisions* live here,
+jax-free and stdlib-only like :mod:`utils.elastic`, so they are unit-testable
+without spawning anything: the launcher executes what :meth:`FleetScheduler.
+plan` returns (spawn / SIGTERM) and reports exits back through
+:meth:`FleetScheduler.on_exit`.
+
+Model
+-----
+- **Pool**: ``pool`` interchangeable devices. A job holds ``world`` of them
+  from launch until its process exits (a job being preempted still holds its
+  devices — they free only when the emergency checkpoint is written and the
+  process is gone).
+- **Jobs** have a priority and a device range ``MIN[:MAX]`` (same grammar as
+  ``--elastic``). Placement is priority-tiered: higher tiers get their
+  minimums first AND grow toward their caps before a lower tier sees a
+  single device. Within one tier, surplus devices are apportioned by the
+  D'Hondt highest-averages method weighted by each job's last recorded
+  goodput fraction (a job that turns devices into steps outbids one that
+  burns them on restarts), quantized to damp run-to-run jitter.
+- **Preemption** reuses the single-job machinery end to end: the launcher
+  SIGTERMs the victim, the trainer's resilience path takes its emergency
+  checkpoint and exits ``PREEMPTED_EXIT_CODE``; the scheduler re-queues the
+  victim with *no restart-budget burn* (being evicted is the scheduler's
+  doing, not the job's) and the relaunch appends ``--resume auto`` — resume
+  is already sample-exact across world-size changes (utils/elastic.py).
+  Victims are chosen lowest-priority-first and only ever from strictly
+  lower tiers; a job can never preempt its own tier.
+- **Backfill / shrink**: a job's allocatable ceiling is
+  ``min(MAX, pool) - |effective_dead_hosts(ckdir)|`` — the same append-only
+  ``dead_hosts.jsonl`` / ``returned_hosts.jsonl`` protocol the elastic
+  supervisor reads. A ``kill_host`` that shrinks one job's gang returns the
+  idled device to the pool, where the next plan hands it to whoever is
+  waiting (the backfill path). A host-return record grows the ceiling back.
+- **Backoff**: failures (including abrupt host loss) burn the per-job
+  restart budget with doubling backoff. A job waiting out its backoff keeps
+  a *claim* on its minimum so lower-priority jobs cannot squat on devices it
+  is about to take back — claims bind only tiers below the claimant.
+
+Determinism contract (the robustness gate diffs placement logs byte-for-
+byte across same-seed chaos drills): no RNG, no wall-clock anywhere in a
+decision. Time enters only as the caller-supplied monotonic ``now_s`` used
+to expire backoff timers, and ``placement.jsonl`` rows carry a sequence
+number, never a timestamp. Ties break on job name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+from pytorch_distributed_training_example_tpu.utils import elastic
+from pytorch_distributed_training_example_tpu.utils import resilience
+
+#: Decision log, one JSON row per scheduling action, in the fleet log dir.
+PLACEMENT_FILE = "placement.jsonl"
+
+#: Merged cluster-wide goodput summary written by the fleet launcher.
+CLUSTER_GOODPUT_FILE = "cluster_goodput.json"
+
+# Job lifecycle.
+PENDING = "pending"        # waiting for devices (or a dependency)
+RUNNING = "running"        # process alive, holds ``world`` devices
+PREEMPTING = "preempting"  # SIGTERM sent; holds devices until exit
+BACKOFF = "backoff"        # failed; eligible again at next_eligible_s
+DONE = "done"              # exit 0
+FAILED = "failed"          # restart budget exhausted / starved
+TERMINAL = (DONE, FAILED)
+
+_STEP_DIR_RE = re.compile(r"^step_\d+$")
+_UNBOUNDED = 1 << 30
+
+
+def parse_world(spec: str) -> tuple[int, int]:
+    """``MIN`` or ``MIN:MAX`` -> (min_world, max_world); MAX defaults open
+    (capped by the pool at plan time) — the ``--elastic`` grammar."""
+    lo, _, hi = str(spec).partition(":")
+    min_world = int(lo)
+    max_world = int(hi) if hi else _UNBOUNDED
+    if min_world < 1 or max_world < min_world:
+        raise ValueError(f"world expects MIN[:MAX] with 1 <= MIN <= MAX, "
+                         f"got {spec!r}")
+    return min_world, max_world
+
+
+# GL002: every filesystem touch in this module goes through one of these
+# helpers under resilience.retriable_io — a transient NFS error must never
+# crash the control plane that is supposed to survive everything else.
+def _read_text(path: str) -> str:
+    with open(path) as fh:
+        return fh.read()
+
+
+def _append_line(path: str, line: str) -> None:
+    # One write call: line-atomic like the dead-host protocol.
+    with open(path, "a") as fh:
+        fh.write(line)
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One entry of ``jobs.json`` (immutable; runtime state lives in
+    :class:`JobState`)."""
+
+    name: str
+    cmd: tuple[str, ...]
+    priority: int = 0
+    min_world: int = 1
+    max_world: int = _UNBOUNDED
+    max_restarts: int = 3
+    backoff_s: float = 1.0
+    after: str | None = None          # submit once this job has...
+    after_event: str = "start"        # ..."start"-ed or written a "checkpoint"
+    env: tuple[tuple[str, str], ...] = ()  # extra child env (sorted pairs)
+
+    @property
+    def checkpoint_dir(self) -> str | None:
+        """The job's ``--checkpoint-dir`` (last wins) — where its dead-host
+        records, chaos log, and goodput.json live."""
+        value = None
+        for i, tok in enumerate(self.cmd[:-1]):
+            if tok == "--checkpoint-dir":
+                value = self.cmd[i + 1]
+        return value
+
+
+@dataclasses.dataclass
+class JobState:
+    spec: JobSpec
+    status: str = PENDING
+    world: int = 0                 # devices held right now
+    restarts: int = 0              # budget burned (preemption is free)
+    attempts: int = 0              # launches so far (drives --resume auto)
+    started: bool = False
+    next_eligible_s: float = 0.0   # backoff deadline (monotonic clock)
+    last_exit: int | None = None
+    weight: float = 1.0            # quantized goodput fraction
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+
+def load_jobs(path: str) -> tuple[int, list[JobSpec]]:
+    """Parse ``jobs.json``: ``{"pool": N, "jobs": [{...}]}``.
+
+    Each job: ``name``, ``cmd`` (argv list, like the launcher's ``--``
+    remainder), optional ``priority`` (int, higher wins), ``world``
+    (``"MIN[:MAX]"``), ``max_restarts``, ``backoff_s``, ``after`` (+
+    ``after_event``: ``start`` | ``checkpoint``) and ``env`` (dict).
+    Validation is eager — a fleet that can never place a job fails at load,
+    not an hour in.
+    """
+    doc = json.loads(resilience.retriable_io(
+        _read_text, path, _what="jobs.json read"))
+    pool = int(doc.get("pool", 0))
+    if pool < 1:
+        raise ValueError(f"jobs.json needs a positive device pool, "
+                         f"got {doc.get('pool')!r}")
+    specs: list[JobSpec] = []
+    names: set[str] = set()
+    for row in doc.get("jobs", []):
+        name = str(row["name"])
+        if name in names:
+            raise ValueError(f"duplicate job name {name!r}")
+        names.add(name)
+        cmd = tuple(str(t) for t in row["cmd"])
+        if not cmd:
+            raise ValueError(f"job {name!r} has an empty cmd")
+        min_world, max_world = parse_world(row.get("world", "1"))
+        if min_world > pool:
+            raise ValueError(f"job {name!r} needs at least {min_world} "
+                             f"devices but the pool is {pool}")
+        after_event = str(row.get("after_event", "start"))
+        if after_event not in ("start", "checkpoint"):
+            raise ValueError(f"job {name!r}: after_event must be 'start' or "
+                             f"'checkpoint', got {after_event!r}")
+        specs.append(JobSpec(
+            name=name, cmd=cmd, priority=int(row.get("priority", 0)),
+            min_world=min_world, max_world=max_world,
+            max_restarts=int(row.get("max_restarts", 3)),
+            backoff_s=float(row.get("backoff_s", 1.0)),
+            after=row.get("after"), after_event=after_event,
+            env=tuple(sorted((str(k), str(v))
+                             for k, v in (row.get("env") or {}).items()))))
+    if not specs:
+        raise ValueError("jobs.json has no jobs")
+    for s in specs:
+        if s.after is not None and s.after not in names:
+            raise ValueError(f"job {s.name!r}: after={s.after!r} names no "
+                             "job in this fleet")
+        if s.after == s.name:
+            raise ValueError(f"job {s.name!r} depends on itself")
+    return pool, specs
+
+
+def quantize_weight(goodput_fraction: float) -> float:
+    """Goodput fraction -> placement weight, quantized to 0.1 steps with a
+    floor so a catastrophically bad attempt still gets a hearing. Coarse on
+    purpose: run-to-run goodput jitter must not flip placement decisions."""
+    return max(0.1, round(float(goodput_fraction), 1))
+
+
+class FleetScheduler:
+    """Deterministic placement over one shared device pool.
+
+    Drive it as an event loop::
+
+        sched = FleetScheduler(pool, specs, log_dir=...)
+        while not sched.finished():
+            for d in sched.plan(now):   # applies transitions, logs rows
+                ...spawn / SIGTERM per d["action"]...
+            ...poll children; sched.on_exit(name, code, now) as they die...
+    """
+
+    def __init__(self, pool: int, specs: list[JobSpec],
+                 log_dir: str | None = None):
+        if pool < 1:
+            raise ValueError(f"pool must be >= 1, got {pool}")
+        self.pool = pool
+        self.jobs: dict[str, JobState] = {}
+        for s in specs:
+            if s.name in self.jobs:
+                raise ValueError(f"duplicate job name {s.name!r}")
+            self.jobs[s.name] = JobState(spec=s)
+        self._seq = 0
+        self._placement_path = (os.path.join(log_dir, PLACEMENT_FILE)
+                                if log_dir else None)
+
+    # ------------------------------------------------------------- queries
+
+    def state(self, name: str) -> JobState:
+        return self.jobs[name]
+
+    def held(self) -> int:
+        """Devices held by live processes (running or still dying)."""
+        return sum(st.world for st in self.jobs.values()
+                   if st.status in (RUNNING, PREEMPTING))
+
+    def free(self) -> int:
+        return self.pool - self.held()
+
+    def finished(self) -> bool:
+        return all(st.status in TERMINAL for st in self.jobs.values())
+
+    def next_deadline_s(self) -> float | None:
+        """Earliest backoff expiry among waiting jobs, or None."""
+        deadlines = [st.next_eligible_s for st in self.jobs.values()
+                     if st.status == BACKOFF]
+        return min(deadlines) if deadlines else None
+
+    def live_jobs(self) -> list[str]:
+        return sorted(n for n, st in self.jobs.items()
+                      if st.status in (RUNNING, PREEMPTING))
+
+    def gauges(self) -> dict[str, float]:
+        """Cluster + per-job gauges for the fleet ``/metrics`` endpoint
+        (exported under the ``pdtx_`` prefix by fleetobs.MetricsServer)."""
+        by_status: dict[str, int] = {}
+        for st in self.jobs.values():
+            by_status[st.status] = by_status.get(st.status, 0) + 1
+        out: dict[str, float] = {
+            "fleet_pool_devices": self.pool,
+            "fleet_devices_held": self.held(),
+            "fleet_devices_free": self.free(),
+            "fleet_jobs_total": len(self.jobs),
+            "fleet_decisions_total": self._seq,
+        }
+        for status in (PENDING, RUNNING, PREEMPTING, BACKOFF, DONE, FAILED):
+            out[f"fleet_jobs_{status}"] = by_status.get(status, 0)
+        for name in sorted(self.jobs):
+            st = self.jobs[name]
+            out[f"fleet_job_world_{name}"] = st.world
+            out[f"fleet_job_restarts_{name}"] = st.restarts
+        return out
+
+    # ------------------------------------------------------------ internals
+
+    def _cap(self, st: JobState) -> int:
+        """Allocatable ceiling right now: the spec's MAX clamped to the pool,
+        minus the job's currently-dead hosts (count-based, so a host return
+        restores the ceiling — same accounting as the elastic supervisor)."""
+        cap = min(st.spec.max_world, self.pool)
+        ckdir = st.spec.checkpoint_dir
+        if ckdir and os.path.isdir(ckdir):
+            cap -= len(elastic.effective_dead_hosts(ckdir))
+        return max(cap, 0)
+
+    def _dep_ready(self, st: JobState) -> bool:
+        if st.spec.after is None:
+            return True
+        dep = self.jobs[st.spec.after]
+        if st.spec.after_event == "checkpoint":
+            ckdir = dep.spec.checkpoint_dir
+            if not ckdir or not os.path.isdir(ckdir):
+                return False
+            try:
+                names = resilience.retriable_io(
+                    os.listdir, ckdir, _what="fleet dep probe")
+            except OSError:
+                return False
+            return any(_STEP_DIR_RE.match(n) for n in names)
+        return dep.started
+
+    def _eligible(self, now_s: float) -> list[JobState]:
+        out = []
+        for st in self.jobs.values():
+            if st.status == PENDING and self._dep_ready(st):
+                out.append(st)
+            elif st.status == BACKOFF and now_s >= st.next_eligible_s:
+                out.append(st)
+        out.sort(key=lambda s: (-s.spec.priority, s.name))
+        return out
+
+    def _claims_above(self, priority: int, now_s: float) -> int:
+        """Devices reserved for higher-priority jobs waiting out a backoff:
+        they will be back, and a lower tier must not squat on their minimum."""
+        return sum(min(st.spec.min_world, self._cap(st))
+                   for st in self.jobs.values()
+                   if st.status == BACKOFF and now_s < st.next_eligible_s
+                   and st.spec.priority > priority)
+
+    def _log(self, action: str, st: JobState, world: int, reason: str):
+        self._seq += 1
+        row = {"seq": self._seq, "action": action, "job": st.name,
+               "world": world, "free": self.free(), "reason": reason}
+        if self._placement_path is not None:
+            resilience.retriable_io(
+                _append_line, self._placement_path, json.dumps(row) + "\n",
+                _what="placement.jsonl append")
+        return row
+
+    # -------------------------------------------------------------- events
+
+    def plan(self, now_s: float) -> list[dict]:
+        """One scheduling pass. Applies transitions (PENDING/BACKOFF ->
+        RUNNING, RUNNING -> PREEMPTING) and returns the decision rows the
+        launcher must execute: ``launch`` (spawn at ``world``) and
+        ``preempt`` (SIGTERM). Deterministic given job states and ``now_s``.
+        """
+        decisions: list[dict] = []
+        eligible = self._eligible(now_s)
+        incoming = sum(st.world for st in self.jobs.values()
+                       if st.status == PREEMPTING)
+        # Priority-tiered: a tier gets its minimums AND grows toward its
+        # caps before any lower tier sees a device.
+        tiers: dict[int, list[JobState]] = {}
+        for st in eligible:
+            tiers.setdefault(st.spec.priority, []).append(st)
+        for priority in sorted(tiers, reverse=True):
+            tier = tiers[priority]  # name-sorted within the tier already
+            avail = self.free() - self._claims_above(priority, now_s)
+            launched: list[JobState] = []
+            for st in tier:
+                cap = self._cap(st)
+                need = st.spec.min_world
+                if cap < need:
+                    continue  # dead hosts ate the range; wait for a return
+                if avail >= need:
+                    st.status = RUNNING
+                    st.world = need
+                    st.started = True
+                    st.attempts += 1
+                    avail -= need
+                    launched.append(st)
+                    continue
+                # Not placeable: preempt strictly-lower tiers, cheapest
+                # victims first (ascending priority, then name), but only
+                # while the shortfall is real — devices already freeing
+                # from in-flight preemptions count as arriving supply.
+                victims = sorted(
+                    (v for v in self.jobs.values()
+                     if v.status == RUNNING and v.spec.priority < priority),
+                    key=lambda v: (v.spec.priority, v.name))
+                chosen: list[JobState] = []
+                freed = 0
+                for v in victims:
+                    if avail + incoming + freed >= need:
+                        break
+                    chosen.append(v)
+                    freed += v.world
+                if avail + incoming + freed < need:
+                    continue  # not satisfiable even by preempting everyone
+                for v in chosen:
+                    v.status = PREEMPTING
+                    incoming += v.world
+                    decisions.append(self._log(
+                        "preempt", v, v.world,
+                        f"preempted for {st.name} (priority "
+                        f"{priority} > {v.spec.priority})"))
+                # The candidate launches on a later pass, once the victims'
+                # emergency checkpoints are written and their devices free.
+            # Surplus within the tier: D'Hondt highest averages, weighted
+            # by quantized goodput, capped per job.
+            while avail > 0:
+                best = None
+                best_score = (-1.0, "")
+                for st in launched:
+                    if st.world >= self._cap(st):
+                        continue
+                    score = (st.weight / (st.world + 1), st.name)
+                    # Higher quotient wins; name ascending breaks ties.
+                    if best is None or score[0] > best_score[0] or (
+                            score[0] == best_score[0]
+                            and score[1] < best_score[1]):
+                        best, best_score = st, score
+                if best is None:
+                    break
+                best.world += 1
+                avail -= 1
+            for st in launched:
+                decisions.append(self._log(
+                    "launch", st, st.world,
+                    f"attempt {st.attempts}, range "
+                    f"{st.spec.min_world}:{min(st.spec.max_world, self.pool)}"
+                    f", cap {self._cap(st)}"))
+        return decisions
+
+    def on_exit(self, name: str, code: int, now_s: float) -> dict:
+        """Record a child exit and transition the job. Returns the logged
+        row. Scheduler-initiated preemption (status PREEMPTING + the
+        graceful exit code) re-queues without burning the restart budget;
+        everything else non-zero burns one restart with doubling backoff
+        until the budget is gone."""
+        st = self.jobs[name]
+        was = st.status
+        held = st.world
+        st.world = 0
+        st.last_exit = code
+        self._refresh_weight(st)
+        if code == 0:
+            st.status = DONE
+            reason = "exit 0"
+        elif was == PREEMPTING and code == resilience.PREEMPTED_EXIT_CODE:
+            st.status = PENDING
+            reason = (f"exit {code} (scheduler preemption) -> requeued, "
+                      "no budget burned")
+        else:
+            st.restarts += 1
+            if st.restarts > st.spec.max_restarts:
+                st.status = FAILED
+                reason = (f"exit {code}; restart budget exhausted "
+                          f"({st.spec.max_restarts})")
+            else:
+                st.status = BACKOFF
+                delay = st.spec.backoff_s * 2 ** (st.restarts - 1)
+                st.next_eligible_s = now_s + delay
+                kind = ("host loss"
+                        if code == resilience.HOST_LOST_EXIT_CODE else
+                        "preemption" if code ==
+                        resilience.PREEMPTED_EXIT_CODE else "failure")
+                reason = (f"exit {code} ({kind}) -> backoff "
+                          f"{delay:g}s, restart "
+                          f"{st.restarts}/{st.spec.max_restarts}")
+        action = {DONE: "done", FAILED: "giveup"}.get(st.status, "exit")
+        return self._log(action, st, held, reason)
+
+    def mark_starved(self) -> list[dict]:
+        """Terminal sweep for the launcher: jobs that can never run (their
+        dependency died checkpoint-less, or dead hosts pinned their ceiling
+        below MIN with nothing left alive to change that) become FAILED so
+        the fleet can report and exit instead of hanging."""
+        rows = []
+        for name in sorted(self.jobs):
+            st = self.jobs[name]
+            if st.status not in TERMINAL:
+                st.status = FAILED
+                rows.append(self._log(
+                    "giveup", st, st.world,
+                    "starved: unplaceable with no live jobs left"))
+        return rows
+
+    def _refresh_weight(self, st: JobState) -> None:
+        ckdir = st.spec.checkpoint_dir
+        if not ckdir:
+            return
+        path = os.path.join(ckdir, "goodput.json")
+        if not os.path.exists(path):
+            return
+        try:
+            doc = json.loads(resilience.retriable_io(
+                _read_text, path, _what="fleet goodput read"))
+            st.weight = quantize_weight(doc["goodput_fraction"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # a torn goodput file must not stall scheduling

@@ -205,6 +205,29 @@ def test_returned_hosts_cancel_dead_records(tmp_path):
     assert elastic.read_dead_hosts(d) == {1, 3}
 
 
+def test_returned_hosts_tolerate_torn_tail_and_read_errors(tmp_path):
+    """The grow-side ledger gets the same degradation contract as the dead
+    side: a torn tail (host died mid-append) skips the bad line, and an
+    OSError on open (ESTALE/EIO, not just a missing file) degrades to "no
+    records seen" — never a crash in the supervisor's planning path."""
+    d = str(tmp_path)
+    elastic.record_host_return(d, 1, reason="repaired")
+    elastic.record_host_return(d, 4, reason="repaired")
+    path = os.path.join(d, elastic.RETURNED_HOSTS_FILE)
+    with open(path, "a") as fh:
+        fh.write('{"host": 9, "reas')  # torn tail: no newline, no close brace
+    assert elastic.read_returned_hosts(d) == {1, 4}
+    # Torn records must not cancel dead ones they never finished recording.
+    elastic.record_dead_host(d, 9, reason="kill")
+    assert elastic.effective_dead_hosts(d) == {9}
+    # Non-ENOENT OSError (IsADirectoryError here) degrades to empty, same
+    # as the dead-host reader.
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / elastic.RETURNED_HOSTS_FILE).mkdir()
+    assert elastic.read_returned_hosts(str(bad)) == set()
+
+
 # ---------------------------------------------------------------------------
 # mesh: elastic_resolve degrades pinned axes instead of refusing
 # ---------------------------------------------------------------------------
@@ -391,6 +414,67 @@ def test_supervisor_coordinator_port_probe(tmp_path):
     assert res.returncode == 0, res.stderr
     assert f"coordinator port {taken} is not bindable" in res.stderr
     assert marker.read_text() != str(taken)
+
+
+def _write_preempt_script(tmp_path):
+    """Fake gang member that is preempted on every attempt — exercises the
+    supervisor's backoff/budget ledger with no elastic machinery in play."""
+    script = tmp_path / "fake_preempt_job.py"
+    script.write_text("import sys\nsys.exit(75)\n")
+    return script
+
+
+def test_supervisor_backoff_doubles_until_budget_exhausted(tmp_path):
+    script = _write_preempt_script(tmp_path)
+    res, _ = _run_launch(tmp_path, script, "--restart-policy", "on-preempt",
+                         "--restart-backoff", "0.2")
+    assert res.returncode == 75, res.stderr
+    err = res.stderr
+    assert "restart 1/2 with --resume auto in 0.2s" in err, err
+    assert "restart 2/2 with --resume auto in 0.4s" in err, err  # doubled
+    assert "restart budget exhausted (2); last exit code 75" in err, err
+    assert err.count("-> restart") == 2  # budget, not one-more-than-budget
+
+
+def _write_repeat_kill_script(tmp_path):
+    """The SAME host dies abruptly on every attempt — a genuinely bad node,
+    not a transient preemption. The supervisor must shrink exactly once
+    (absolute dead-host accounting: the second record of host 1 is not a
+    NEW loss) and then burn the restart budget with doubling backoff,
+    rather than shrinking again or restarting forever."""
+    script = tmp_path / "fake_repeat_kill_job.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "args = sys.argv[1:]\n"
+        "ckdir = args[args.index('--checkpoint-dir') + 1]\n"
+        "os.makedirs(ckdir, exist_ok=True)\n"
+        "rank = int(os.environ.get('PROCESS_ID', '0'))\n"
+        "world = int(os.environ.get('NUM_PROCESSES', '1'))\n"
+        "if rank == 0:\n"
+        "    with open(os.path.join(ckdir, 'dead_hosts.jsonl'), 'a') as fh:\n"
+        "        fh.write(json.dumps({'host': 1, 'world': world}) + '\\n')\n"
+        "os._exit(76)\n")
+    return script
+
+
+def test_supervisor_repeated_same_host_loss_exhausts_budget(tmp_path):
+    script = _write_repeat_kill_script(tmp_path)
+    res, ckdir = _run_launch(tmp_path, script, "--elastic", "1",
+                             "--restart-backoff", "0.2")
+    assert res.returncode == 76, res.stderr
+    err = res.stderr
+    # One shrink for the first loss; re-recording the same host is not news.
+    assert err.count("relaunching at world size 1") == 1, err
+    assert "host(s) [1] lost" in err, err
+    assert "restart 1/2 with --resume auto in 0.2s" in err, err
+    assert "restart 2/2 with --resume auto in 0.4s" in err, err
+    assert "restart budget exhausted (2); last exit code 76" in err, err
+    # Every attempt recorded the host: the ledger holds three records but
+    # only ever one effectively-dead host.
+    recs = [json.loads(line) for line in
+            (ckdir / "dead_hosts.jsonl").read_text().splitlines()]
+    assert len(recs) == 3 and {r["host"] for r in recs} == {1}
+    assert elastic.effective_dead_hosts(str(ckdir)) == {1}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,495 @@
+"""Multi-tenant fleet scheduler (ISSUE r16): placement, preemption, backfill.
+
+The pure layer (utils/scheduler.py) is driven directly — every decision is a
+function of job states and a caller-supplied clock, so the priority /
+preemption / backoff / backfill semantics are tested without spawning
+anything. The fleet e2e test runs the real ``launch.py --fleet`` control
+loop against jax-free stub jobs (the test_elastic.py supervisor style); the
+drill with the *real* trainer lives in the dryrun gauntlet
+(__graft_entry__.py leg 16).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_training_example_tpu.utils import elastic
+from pytorch_distributed_training_example_tpu.utils import fleetobs
+from pytorch_distributed_training_example_tpu.utils import (
+    scheduler as scheduler_lib)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_regression(*argv):
+    spec = importlib.util.spec_from_file_location(
+        "check_regression_under_test",
+        os.path.join(REPO, "benchmarks", "check_regression.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(list(argv))
+
+
+def _spec(name, ckdir=None, **kw):
+    cmd = ["job.py"]
+    if ckdir is not None:
+        cmd += ["--checkpoint-dir", str(ckdir)]
+    return scheduler_lib.JobSpec(name=name, cmd=tuple(cmd), **kw)
+
+
+# ---------------------------------------------------------------------------
+# parse_world / load_jobs
+# ---------------------------------------------------------------------------
+
+
+def test_parse_world_grammar():
+    assert scheduler_lib.parse_world("2") == (2, 1 << 30)
+    assert scheduler_lib.parse_world("1:4") == (1, 4)
+    for junk in ("0", "4:2", "0:3"):
+        with pytest.raises(ValueError):
+            scheduler_lib.parse_world(junk)
+
+
+def test_load_jobs_parses_and_validates(tmp_path):
+    path = tmp_path / "jobs.json"
+    path.write_text(json.dumps({"pool": 4, "jobs": [
+        {"name": "a", "cmd": ["main.py", "--checkpoint-dir", "/ck/a"],
+         "world": "1:2", "priority": 5, "backoff_s": 0.5,
+         "env": {"FOO": "1"}},
+        {"name": "b", "cmd": ["main.py"], "after": "a",
+         "after_event": "checkpoint"},
+    ]}))
+    pool, specs = scheduler_lib.load_jobs(str(path))
+    assert pool == 4
+    a, b = specs
+    assert (a.min_world, a.max_world, a.priority) == (1, 2, 5)
+    assert a.checkpoint_dir == "/ck/a"
+    assert a.env == (("FOO", "1"),)
+    assert b.after == "a" and b.after_event == "checkpoint"
+    assert b.checkpoint_dir is None
+
+    for bad in (
+        {"pool": 0, "jobs": [{"name": "a", "cmd": ["x"]}]},
+        {"pool": 2, "jobs": []},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": []}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"]},
+                             {"name": "a", "cmd": ["x"]}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "world": "3"}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "after": "ghost"}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "after": "a"}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"],
+                              "after_event": "vibes"}]},
+    ):
+        path.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            scheduler_lib.load_jobs(str(path))
+
+
+# ---------------------------------------------------------------------------
+# plan(): tiers, surplus, caps, claims
+# ---------------------------------------------------------------------------
+
+
+def test_single_job_gets_min_plus_surplus_to_cap():
+    sched = scheduler_lib.FleetScheduler(4, [_spec("a", min_world=1,
+                                                  max_world=3)])
+    (d,) = sched.plan(0.0)
+    assert (d["action"], d["job"], d["world"]) == ("launch", "a", 3)
+    assert sched.free() == 1  # MAX capped below the pool
+
+
+def test_priority_tier_grows_before_lower_tier_sees_devices():
+    sched = scheduler_lib.FleetScheduler(4, [
+        _spec("lo", priority=0, min_world=1, max_world=4),
+        _spec("hi", priority=1, min_world=1, max_world=3),
+    ])
+    ds = sched.plan(0.0)
+    worlds = {d["job"]: d["world"] for d in ds if d["action"] == "launch"}
+    # hi takes its cap first; lo backfills what is left.
+    assert worlds == {"hi": 3, "lo": 1}
+
+
+def test_surplus_within_tier_is_goodput_weighted():
+    sched = scheduler_lib.FleetScheduler(8, [
+        _spec("a", min_world=1), _spec("b", min_world=1),
+    ])
+    sched.state("a").weight = 0.9
+    sched.state("b").weight = 0.3
+    ds = sched.plan(0.0)
+    worlds = {d["job"]: d["world"] for d in ds}
+    # D'Hondt over 6 surplus seats at weights 0.9 vs 0.3: quotients give
+    # the productive job 5 of the 6 (plus its min).
+    assert worlds["a"] + worlds["b"] == 8
+    assert worlds["a"] > worlds["b"]
+    assert worlds == {"a": 6, "b": 2}
+
+
+def test_equal_weights_split_surplus_evenly_name_tiebreak():
+    sched = scheduler_lib.FleetScheduler(5, [
+        _spec("a", min_world=1), _spec("b", min_world=1),
+    ])
+    ds = sched.plan(0.0)
+    worlds = {d["job"]: d["world"] for d in ds}
+    assert worlds == {"a": 3, "b": 2}  # odd seat goes to the earlier name
+
+
+def test_dead_hosts_cap_allocation_and_returns_restore_it(tmp_path):
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(
+        4, [_spec("a", ckdir=ck, min_world=2, max_world=4)])
+    elastic.record_dead_host(str(ck), 3, reason="probe")
+    (d,) = sched.plan(0.0)
+    assert d["world"] == 3  # 4 minus one currently-dead host
+    sched.on_exit("a", 0, 1.0)
+
+    # Below MIN the job is unplaceable; a host return reopens the range.
+    sched = scheduler_lib.FleetScheduler(
+        4, [_spec("b", ckdir=ck, min_world=4, max_world=4)])
+    assert sched.plan(0.0) == []
+    assert sched.state("b").status == scheduler_lib.PENDING
+    elastic.record_host_return(str(ck), 3, reason="repaired")
+    (d,) = sched.plan(1.0)
+    assert d["world"] == 4
+
+
+def test_preemption_evicts_cheapest_strictly_lower_tier():
+    sched = scheduler_lib.FleetScheduler(4, [
+        _spec("a", priority=0, min_world=2, max_world=2),
+        _spec("b", priority=1, min_world=2, max_world=2),
+        # Arrival gated on a's start so the first pass fills the pool with
+        # the low tiers before the big job shows up.
+        _spec("c", priority=5, min_world=3, after="a"),
+    ])
+    ds = sched.plan(0.0)
+    assert {d["job"] for d in ds if d["action"] == "launch"} == {"a", "b"}
+    # c arrives needing 3; preemption picks the LOWEST tier first (a) and
+    # keeps evicting upward until the shortfall is covered.
+    ds = sched.plan(1.0)
+    preempts = [d for d in ds if d["action"] == "preempt"]
+    assert [d["job"] for d in preempts] == ["a", "b"]  # needs 3, frees 2+2
+    assert sched.state("a").status == scheduler_lib.PREEMPTING
+    # While victims are dying, no double-preemption on the next pass.
+    assert sched.plan(2.0) == []
+    sched.on_exit("a", 75, 3.0)
+    sched.on_exit("b", 75, 3.0)
+    (d,) = sched.plan(4.0)
+    assert (d["job"], d["world"]) == ("c", 4)
+    # Equal tier never preempts itself: a cannot evict b back.
+    assert all(x["action"] != "preempt" for x in sched.plan(5.0))
+
+
+def test_scheduler_preemption_requeues_without_budget_burn():
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("lo", priority=0),
+        _spec("hi", priority=9, min_world=2, after="lo")])
+    sched.plan(0.0)  # lo takes the pool; hi hasn't arrived yet
+    sched.plan(1.0)  # hi preempts lo
+    row = sched.on_exit("lo", 75, 2.0)
+    st = sched.state("lo")
+    assert st.status == scheduler_lib.PENDING
+    assert st.restarts == 0
+    assert "no budget burned" in row["reason"]
+
+
+def test_failure_backoff_doubles_then_budget_exhausts():
+    sched = scheduler_lib.FleetScheduler(
+        2, [_spec("a", max_restarts=2, backoff_s=1.0)])
+    sched.plan(0.0)
+    row = sched.on_exit("a", 76, 10.0)
+    st = sched.state("a")
+    assert st.status == scheduler_lib.BACKOFF
+    assert st.next_eligible_s == 11.0 and "restart 1/2" in row["reason"]
+    assert sched.plan(10.5) == []  # timer not expired
+    sched.plan(11.5)
+    assert st.status == scheduler_lib.RUNNING
+    row = sched.on_exit("a", 1, 20.0)
+    assert st.next_eligible_s == 22.0  # doubled
+    sched.plan(22.5)
+    row = sched.on_exit("a", 1, 30.0)
+    assert st.status == scheduler_lib.FAILED
+    assert row["action"] == "giveup" and "exhausted" in row["reason"]
+    assert sched.finished()
+
+
+def test_backoff_claim_blocks_lower_tier_from_squatting():
+    sched = scheduler_lib.FleetScheduler(3, [
+        _spec("lo", priority=0, min_world=1, max_world=3, backoff_s=5.0),
+        _spec("hi", priority=9, min_world=2, max_world=3, backoff_s=5.0),
+    ])
+    sched.plan(0.0)  # hi 3, lo starved
+    assert sched.state("hi").world == 3
+    sched.on_exit("hi", 76, 1.0)  # backoff until 6.0
+    (d,) = sched.plan(2.0)
+    # lo backfills ONLY what hi's claim leaves over: 3 - min(2, cap) = 1.
+    assert (d["job"], d["world"]) == ("lo", 1)
+    sched.plan(7.0)
+    assert sched.state("hi").world == 2  # relaunched inside its claim
+
+
+def test_dependency_gates_eligibility(tmp_path):
+    ck = tmp_path / "dep_ck"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("a", ckdir=ck, max_world=1),
+        _spec("b", after="a", after_event="checkpoint"),
+        _spec("c", after="a"),  # after_event=start
+    ])
+    ds = sched.plan(0.0)
+    assert {d["job"] for d in ds} == {"a"}  # b, c both gated
+    ds = sched.plan(1.0)
+    assert {d["job"] for d in ds} == {"c"}  # a started; b needs a checkpoint
+    (ck / "step_00000004").mkdir()
+    sched.on_exit("c", 0, 2.0)
+    ds = sched.plan(3.0)
+    assert {d["job"] for d in ds} == {"b"}
+
+
+def test_mark_starved_and_gauges():
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("a"), _spec("b", after="a", after_event="checkpoint")])
+    sched.plan(0.0)
+    sched.on_exit("a", 0, 1.0)  # done, never checkpointed -> b is stuck
+    assert sched.plan(2.0) == []
+    g = sched.gauges()
+    assert g["fleet_pool_devices"] == 2 and g["fleet_jobs_pending"] == 1
+    assert g["fleet_job_world_a"] == 0
+    rows = sched.mark_starved()
+    assert [r["job"] for r in rows] == ["b"]
+    assert sched.finished()
+    assert sched.gauges()["fleet_jobs_failed"] == 1
+
+
+def test_placement_log_is_deterministic_and_timestamp_free(tmp_path):
+    def drill(log_dir):
+        os.makedirs(log_dir, exist_ok=True)
+        sched = scheduler_lib.FleetScheduler(3, [
+            _spec("lo", priority=0, max_world=2, backoff_s=1.0),
+            _spec("hi", priority=9, min_world=2, max_world=3,
+                  backoff_s=1.0, after="lo"),
+        ], log_dir=log_dir)
+        sched.plan(0.0)          # lo -> 2
+        sched.plan(1.0)          # hi preempts lo
+        sched.on_exit("lo", 75, 2.0)
+        sched.plan(3.0)          # hi -> 3
+        sched.on_exit("hi", 76, 4.0)
+        sched.plan(4.5)          # lo backfills at 1 under hi's claim
+        sched.plan(6.0)          # hi relaunches at its claim
+        sched.on_exit("lo", 0, 7.0)
+        sched.on_exit("hi", 0, 8.0)
+        return open(os.path.join(log_dir,
+                                 scheduler_lib.PLACEMENT_FILE)).read()
+
+    a = drill(str(tmp_path / "run_a"))
+    b = drill(str(tmp_path / "run_b"))
+    assert a == b
+    rows = [json.loads(line) for line in a.splitlines()]
+    assert [r["seq"] for r in rows] == list(range(1, len(rows) + 1))
+    assert all(set(r) == {"seq", "action", "job", "world", "free", "reason"}
+               for r in rows)  # no timestamps, ever
+    assert [r["action"] for r in rows] == [
+        "launch", "preempt", "exit", "launch", "exit", "launch", "launch",
+        "done", "done"]
+
+
+def test_quantize_weight_floors_and_damps():
+    assert scheduler_lib.quantize_weight(0.93) == 0.9
+    assert scheduler_lib.quantize_weight(0.88) == 0.9
+    assert scheduler_lib.quantize_weight(0.0) == 0.1
+    assert scheduler_lib.quantize_weight(-1.0) == 0.1
+
+
+# ---------------------------------------------------------------------------
+# cluster goodput aggregation + gate
+# ---------------------------------------------------------------------------
+
+
+def _job_goodput(run_id, wall, step_s, restart_s=0.0, attempts=1):
+    cov = (step_s + restart_s) / wall
+    return {"run_id": run_id, "wall_s": wall,
+            "categories_s": {"step": step_s, "restart": restart_s},
+            "counts": {"step": 10}, "coverage": round(cov, 4),
+            "goodput_fraction": round(step_s / wall, 4),
+            "attempts": attempts}
+
+
+def test_aggregate_cluster_goodput_sums_and_keeps_run_ids():
+    agg = fleetobs.aggregate_cluster_goodput({
+        "hi": _job_goodput("run-hi", 10.0, 9.0, restart_s=0.8, attempts=2),
+        "lo": _job_goodput("run-lo", 5.0, 4.8),
+    })
+    assert agg["cluster"] is True
+    assert agg["jobs"] == ["hi", "lo"]
+    assert sorted(agg["run_ids"]) == ["run-hi", "run-lo"]
+    assert agg["wall_s"] == 15.0
+    assert agg["categories_s"]["step"] == 13.8
+    assert agg["goodput_fraction"] == round(13.8 / 15.0, 4)
+    assert agg["coverage"] == round(14.6 / 15.0, 4)
+    assert agg["attempts"] == 3
+    assert agg["per_job"]["lo"]["run_id"] == "run-lo"
+    assert fleetobs.aggregate_cluster_goodput({}) == {}
+
+
+def test_cluster_goodput_gate_accepts_distinct_run_ids(tmp_path, capsys):
+    agg = fleetobs.aggregate_cluster_goodput({
+        "hi": _job_goodput("run-hi", 10.0, 9.0, restart_s=0.8),
+        "lo": _job_goodput("run-lo", 5.0, 4.8),
+    })
+    path = tmp_path / "cluster_goodput.json"
+    path.write_text(json.dumps(agg))
+    # Without --cluster the distinct run_ids trip the mixed-run refusal...
+    assert _check_regression("--goodput", str(path)) == 1
+    assert "MIXED-RUN" in capsys.readouterr().out
+    # ...with it, they are the expected multi-tenant shape.
+    assert _check_regression("--goodput", str(path), "--cluster") == 0
+    out = capsys.readouterr().out
+    assert "OK cluster goodput" in out and "2 job(s)" in out
+
+
+def test_cluster_goodput_gate_still_enforces_coverage(tmp_path, capsys):
+    bad = fleetobs.aggregate_cluster_goodput(
+        {"a": _job_goodput("run-a", 10.0, 5.0)})
+    path = tmp_path / "cluster_goodput.json"
+    path.write_text(json.dumps(bad))
+    assert _check_regression("--goodput", str(path), "--cluster") == 1
+    assert "REGRESSION cluster goodput" in capsys.readouterr().out
+    # And a single-run file is rejected under --cluster (wrong schema).
+    path.write_text(json.dumps(_job_goodput("run-a", 10.0, 9.9)))
+    assert _check_regression("--goodput", str(path), "--cluster") == 1
+    assert "MALFORMED" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# fleet e2e: the real launch.py --fleet loop over jax-free stub jobs
+# ---------------------------------------------------------------------------
+
+
+_STUB_JOB = """\
+import json, os, signal, sys, time
+args = sys.argv[1:]
+ckdir = args[args.index('--checkpoint-dir') + 1]
+name = args[args.index('--name') + 1]
+os.makedirs(ckdir, exist_ok=True)
+world = 0
+for tok in os.environ.get('XLA_FLAGS', '').split():
+    if 'device_count=' in tok:
+        world = int(tok.split('=')[1])
+
+def write_goodput():
+    with open(os.path.join(ckdir, 'goodput.json'), 'w') as fh:
+        json.dump({'run_id': 'run-' + name, 'wall_s': 1.0,
+                   'coverage': 0.97, 'goodput_fraction': 0.9,
+                   'categories_s': {'step': 0.9, 'restart': 0.07},
+                   'counts': {'step': 10},
+                   'attempts': 1 + ('--resume' in args)}, fh)
+
+def on_term(signum, frame):
+    # The emergency-checkpoint-and-yield path, stubbed.
+    os.makedirs(os.path.join(ckdir, 'step_00000001'), exist_ok=True)
+    write_goodput()
+    with open(os.path.join(ckdir, 'preempted.txt'), 'a') as fh:
+        fh.write('world=%d\\n' % world)
+    os._exit(75)
+
+signal.signal(signal.SIGTERM, on_term)
+if '--resume' in args:
+    with open(os.path.join(ckdir, 'resumed.txt'), 'w') as fh:
+        fh.write('world=%d' % world)
+    write_goodput()
+    sys.exit(0)
+os.makedirs(os.path.join(ckdir, 'step_00000001'), exist_ok=True)
+if '--short' in args:
+    time.sleep(0.3)
+    write_goodput()
+    sys.exit(0)
+time.sleep(60)
+sys.exit(1)
+"""
+
+
+def _run_fleet(tmp_path, tag):
+    work = tmp_path / tag
+    work.mkdir()
+    stub = work / "stub_job.py"
+    stub.write_text(_STUB_JOB)
+    lo_ck, hi_ck = work / "ck_lo", work / "ck_hi"
+    jobs = work / "jobs.json"
+    jobs.write_text(json.dumps({"pool": 3, "jobs": [
+        {"name": "lo", "priority": 0, "world": "1:2", "backoff_s": 0.1,
+         "cmd": [str(stub), "--name", "lo",
+                 "--checkpoint-dir", str(lo_ck)]},
+        {"name": "hi", "priority": 10, "world": "2:3", "backoff_s": 0.1,
+         "after": "lo", "after_event": "checkpoint",
+         "cmd": [str(stub), "--name", "hi", "--short",
+                 "--checkpoint-dir", str(hi_ck)]},
+    ]}))
+    res = subprocess.run(
+        [sys.executable, "launch.py", "--fleet", str(jobs),
+         "--log-dir", str(work), "--fleet-poll", "0.05"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    return res, work, lo_ck, hi_ck
+
+
+def test_fleet_preempts_backfills_and_aggregates_goodput(tmp_path):
+    res, work, lo_ck, hi_ck = _run_fleet(tmp_path, "run_a")
+    assert res.returncode == 0, res.stderr
+    err = res.stderr
+    # lo launched wide, was preempted for hi, and resumed afterwards.
+    assert "launch lo at world 2 (attempt 1)" in err, err
+    assert "preempt lo" in err and "priority 10 > 0" in err, err
+    assert "launch hi at world 3 (attempt 1)" in err, err
+    assert "launch lo at world 2 (attempt 2)" in err, err
+    assert (lo_ck / "preempted.txt").read_text() == "world=2\n"
+    assert (lo_ck / "resumed.txt").read_text() == "world=2"
+    # Decision order in the placement log: preempt strictly before hi runs.
+    rows = [json.loads(line) for line in
+            (work / "placement.jsonl").read_text().splitlines()]
+    actions = [(r["action"], r["job"]) for r in rows]
+    assert actions.index(("preempt", "lo")) < actions.index(("launch", "hi"))
+    assert ("done", "hi") in actions and ("done", "lo") in actions
+    # Cluster aggregation: one summary, both jobs, distinct run ids, gated.
+    agg = json.loads((work / "cluster_goodput.json").read_text())
+    assert agg["jobs"] == ["hi", "lo"]
+    assert sorted(agg["run_ids"]) == ["run-hi", "run-lo"]
+    assert _check_regression("--goodput", str(work / "cluster_goodput.json"),
+                             "--cluster") == 0
+
+    # Same fleet, second run: the decision stream is event-chained, so the
+    # placement log is byte-identical (the determinism contract).
+    res_b, work_b, _, _ = _run_fleet(tmp_path, "run_b")
+    assert res_b.returncode == 0, res_b.stderr
+    assert ((work / "placement.jsonl").read_text()
+            == (work_b / "placement.jsonl").read_text())
+
+
+def test_fleet_starved_job_fails_the_fleet(tmp_path):
+    work = tmp_path / "starved"
+    work.mkdir()
+    stub = work / "stub_job.py"
+    stub.write_text(_STUB_JOB)
+    jobs = work / "jobs.json"
+    jobs.write_text(json.dumps({"pool": 2, "jobs": [
+        {"name": "a", "world": "1", "cmd": [
+            str(stub), "--name", "a", "--short",
+            "--checkpoint-dir", str(work / "ck_a")]},
+        # b waits for a checkpoint a never... a DOES write one; gate b on a
+        # job that never starts instead: depend on itself via a dead range.
+        {"name": "b", "world": "2:2", "max_restarts": 0, "cmd": [
+            str(stub), "--name", "b", "--short",
+            "--checkpoint-dir", str(work / "ck_b")]},
+    ]}))
+    # Pin b's range shut before the fleet starts: 2 dead hosts -> cap 0.
+    (work / "ck_b").mkdir()
+    elastic.record_dead_host(str(work / "ck_b"), 0, reason="pinned")
+    elastic.record_dead_host(str(work / "ck_b"), 1, reason="pinned")
+    res = subprocess.run(
+        [sys.executable, "launch.py", "--fleet", str(jobs),
+         "--log-dir", str(work), "--fleet-poll", "0.05"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1, res.stderr
+    assert "give up on b" in res.stderr, res.stderr
+    assert "'a': 'done'" in res.stderr and "'b': 'failed'" in res.stderr

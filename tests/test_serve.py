@@ -300,3 +300,83 @@ def test_restore_params_for_inference(tmp_path, devices):
                                           x.dtype), template)
     with pytest.raises(ValueError, match="does not match this model"):
         ck.restore_params(bad)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain (fleet preemption contract for serving jobs)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_step_admit_false_freezes_waiting_queue(devices):
+    from pytorch_distributed_training_example_tpu.serve import run as serve_run
+    from pytorch_distributed_training_example_tpu.utils import resilience
+
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=32, page_size=8)
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1, 2), prompt_buckets=(16,),
+        max_model_len=32)
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        eng.submit(engine_lib.Request(
+            request_id=f"r{i}", prompt=rng.integers(1, 512, 4).tolist(),
+            max_new_tokens=3))
+    eng.step()  # admits up to the 2 decode slots; r2 stays waiting
+    assert eng.num_active == 2 and len(eng.waiting) == 1
+    # Drain mode: active slots decode to completion, nothing new is admitted.
+    resilience.reset()
+    resilience.trip()
+    try:
+        assert resilience.preempted()
+        outcome = serve_run.serve_loop(
+            loadgen.OpenLoopDriver([]), eng, drain_timeout_s=30.0)
+    finally:
+        resilience.reset()
+    assert outcome["preempted"] is True and outcome["drained"] is True
+    assert eng.num_active == 0
+    assert len(eng.waiting) == 1  # the un-admitted request was NOT started
+    assert {r.request_id for r in eng.completed} == {"r0", "r1"}
+
+
+def test_serve_loop_drain_timeout_bounds_shutdown(devices):
+    from pytorch_distributed_training_example_tpu.serve import run as serve_run
+    from pytorch_distributed_training_example_tpu.utils import resilience
+
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=32, page_size=8)
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1,), prompt_buckets=(16,),
+        max_model_len=32)
+    eng.submit(engine_lib.Request(request_id="slow", prompt=[5, 6, 7],
+                                  max_new_tokens=20))
+    eng.step()
+    assert eng.num_active == 1
+    resilience.reset()
+    resilience.trip()
+    try:
+        # Zero budget: the loop must exit immediately, reporting the
+        # sequence it had to abandon rather than hanging on it.
+        outcome = serve_run.serve_loop(
+            loadgen.OpenLoopDriver([]), eng, drain_timeout_s=0.0)
+    finally:
+        resilience.reset()
+    assert outcome["preempted"] is True
+    assert outcome["drained"] is False
+    assert eng.num_active == 1
+
+
+def test_serve_loop_without_preemption_reports_clean_exit(devices):
+    from pytorch_distributed_training_example_tpu.serve import run as serve_run
+
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=32, page_size=8)
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1, 2), prompt_buckets=(16,),
+        max_model_len=32)
+    reqs = [engine_lib.Request(request_id=f"r{i}", prompt=[1 + i, 2, 3],
+                               max_new_tokens=2, arrival_time=0.0)
+            for i in range(3)]
+    outcome = serve_run.serve_loop(loadgen.OpenLoopDriver(reqs), eng,
+                                   drain_timeout_s=5.0)
+    assert outcome["preempted"] is False and outcome["drained"] is True
+    assert len(eng.completed) == 3
