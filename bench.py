@@ -53,6 +53,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                moe_combine_dtype: str = "fp32",
                moe_router_dtype: str = "fp32",
                moe_router_impl: str = "reference",
+               moe_ep_dispatch: str = "replicated",
+               moe_ep_overlap_chunks: int = 2,
                remat_policy: str = "nothing", telemetry: bool = False):
     """Build (mesh, state, step_fn, device batch, bundle) exactly as the
     benchmark measures them — shared by bench() and benchmarks/profile_step.py
@@ -83,6 +85,8 @@ def setup_step(model_name: str = "resnet50", image_size: int = 224,
                                    moe_combine_dtype=moe_combine_dtype,
                                    moe_router_dtype=moe_router_dtype,
                                    moe_router_impl=moe_router_impl,
+                                   moe_ep_dispatch=moe_ep_dispatch,
+                                   moe_ep_overlap_chunks=moe_ep_overlap_chunks,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -109,6 +113,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
           moe_capacity_factor: float = 1.25, moe_top_k: int = 2,
           moe_dispatch_impl: str = "gather", moe_combine_dtype: str = "fp32",
           moe_router_dtype: str = "fp32", moe_router_impl: str = "reference",
+          moe_ep_dispatch: str = "replicated",
+          moe_ep_overlap_chunks: int = 2,
           remat_policy: str = "nothing", telemetry: bool = False,
           fleet_obs: bool = False):
     import jax
@@ -124,6 +130,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                     moe_combine_dtype=moe_combine_dtype,
                     moe_router_dtype=moe_router_dtype,
                     moe_router_impl=moe_router_impl,
+                    moe_ep_dispatch=moe_ep_dispatch,
+                    moe_ep_overlap_chunks=moe_ep_overlap_chunks,
                     remat_policy=remat_policy, telemetry=telemetry)
     mesh, state, step, batch, bundle = (su["mesh"], su["state"], su["step"],
                                         su["batch"], su["bundle"])
@@ -293,6 +301,8 @@ def bench(model_name: str = "resnet50", image_size: int = 224,
                 "moe_combine_dtype": moe_combine_dtype,
                 "moe_router_dtype": moe_router_dtype,
                 "moe_router_impl": moe_router_impl,
+                "moe_ep_dispatch": moe_ep_dispatch,
+                "moe_ep_overlap_chunks": moe_ep_overlap_chunks,
                 "moe_capacity_factor": moe_capacity_factor}
                if "moe" in model_name else {}),
             **({"remat_policy": remat_policy}
@@ -503,6 +513,15 @@ def main(argv=None):
                         "(ops/fused_router.py)")
     p.add_argument("--moe-combine", default="fp32", choices=["fp32", "bf16"],
                    help="combine-einsum precision (router stays fp32)")
+    p.add_argument("--moe-ep-dispatch", default="replicated",
+                   choices=["replicated", "a2a", "a2a_overlap"],
+                   dest="moe_ep_dispatch",
+                   help="dropless EP transport: replicated weights, "
+                        "all-to-all token shards, or chunked a2a/gmm "
+                        "overlap (parallel/moe.py)")
+    p.add_argument("--moe-ep-overlap-chunks", type=int, default=2,
+                   dest="moe_ep_overlap_chunks",
+                   help="a2a_overlap double-buffer windows over the token dim")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="MoE expert capacity factor (llama_moe rows)")
     p.add_argument("--attn-impl", default="auto",
@@ -541,6 +560,8 @@ def main(argv=None):
                    moe_combine_dtype=args.moe_combine,
                    moe_router_dtype=args.moe_router_dtype,
                    moe_router_impl=args.moe_router_impl,
+                   moe_ep_dispatch=args.moe_ep_dispatch,
+                   moe_ep_overlap_chunks=args.moe_ep_overlap_chunks,
                    remat_policy=args.remat_policy, telemetry=args.telemetry,
                    fleet_obs=args.fleet_obs)
     if (args.model == "resnet50" and not args.no_measured_roofline):

@@ -171,9 +171,15 @@ def check_goodput(path: str, min_coverage: float = 0.95,
 
 
 def aot_key(result: dict) -> str:
-    """Golden key for an aot_report: model + shape + dispatch formulation."""
-    return (f"{result['model']} b{result['per_chip_batch']} "
-            f"s{result['seq_len']} {result.get('moe_dispatch_impl', '-')}")
+    """Golden key for an aot_report: model + shape + dispatch formulation.
+    EP rows (lowered at an expert mesh) extend the key with the degree and
+    transport so replicated/a2a/a2a_overlap goldens coexist per shape."""
+    key = (f"{result['model']} b{result['per_chip_batch']} "
+           f"s{result['seq_len']} {result.get('moe_dispatch_impl', '-')}")
+    if int(result.get("ep_degree", 1) or 1) > 1:
+        key += (f" ep{result['ep_degree']} "
+                f"{result.get('moe_ep_dispatch', 'replicated')}")
+    return key
 
 
 def check_aot_bytes(result: dict, golden: dict, tolerance: float = 0.10):
@@ -212,6 +218,42 @@ def check_aot_bytes(result: dict, golden: dict, tolerance: float = 0.10):
             report.append("REGRESSION " + line)
         else:
             report.append("OK " + line)
+    # EP comms model (r17): collective moe bytes regress upward like any
+    # traffic number, and an a2a row must also UNDERCUT its replicated
+    # sibling golden at the same shape/degree — the whole point of sharding
+    # the dropless path is that token shards cost less than weight gathers,
+    # so losing that inequality is a regression even inside tolerance.
+    coll = result.get("collectives")
+    ref_coll = entry.get("collectives")
+    if coll and ref_coll and ref_coll.get("moe_bytes") is not None:
+        val, ref = float(coll["moe_bytes"]), float(ref_coll["moe_bytes"])
+        ratio = val / ref if ref else (float("inf") if val else 1.0)
+        line = (f"aot_collective_moe_bytes ({key}): {val / 1e6:.3f} MB vs "
+                f"golden {ref / 1e6:.3f} MB ({ratio:.2%})")
+        if ratio > 1.0 + tolerance:
+            failures.append(line)
+            report.append("REGRESSION " + line)
+        else:
+            report.append("OK " + line)
+    ep_dispatch = result.get("moe_ep_dispatch", "replicated")
+    if (coll and int(result.get("ep_degree", 1) or 1) > 1
+            and ep_dispatch != "replicated"):
+        rep_key = aot_key({**result, "moe_ep_dispatch": "replicated"})
+        rep = golden.get("aot_regions", {}).get(rep_key, {}).get("collectives")
+        if rep is None:
+            report.append(f"NO-GOLDEN aot_regions[{rep_key}]: record the "
+                          "replicated sibling to arm the a2a<replicated gate")
+        else:
+            val, ref = float(coll["moe_bytes"]), float(rep["moe_bytes"])
+            line = (f"aot_ep_comms ({key}): moe collective bytes "
+                    f"{val / 1e6:.3f} MB vs replicated golden "
+                    f"{ref / 1e6:.3f} MB")
+            if val >= ref:
+                failures.append(line + " — a2a no longer undercuts "
+                                "replicated weight gathers")
+                report.append("REGRESSION " + line)
+            else:
+                report.append("OK " + line)
     return failures, report
 
 
@@ -228,6 +270,14 @@ def record_aot_golden(result: dict, path: str = GOLDEN_PATH) -> str:
     }
     if result.get("xla_flops_per_step") is not None:
         entry["xla_flops_per_step"] = result["xla_flops_per_step"]
+    coll = result.get("collectives")
+    if coll:
+        entry["collectives"] = {
+            "total_bytes": coll["total_bytes"],
+            "moe_bytes": coll["moe_bytes"],
+            "by_opcode": {op: row["bytes"]
+                          for op, row in coll.get("by_opcode", {}).items()},
+        }
     golden.setdefault("aot_regions", {})[aot_key(result)] = entry
     with open(path, "w") as fh:
         json.dump(golden, fh, indent=2)

@@ -23,7 +23,24 @@ reused the train noise stream):
 A-priori acceptance (asserted by tests/test_convergence.py): held-out
 top-1 >= 0.90 by the final epoch, and |seen - heldout| <= 0.10.
 
+``--task lm`` (r17) is the LM counterpart with an ENTROPY-FLOOR gate
+instead of an accuracy threshold. The synthetic LM stream
+(``SyntheticTokenDataset``) draws tokens i.i.d. uniform over the vocab,
+so the best achievable next-token loss is exactly ``ln(vocab_size)``
+nats/token (6.2383 for llama_tiny's vocab of 512) — no model can beat
+it without cheating. The gate is two-sided:
+
+- final eval loss <= floor + margin: the optimizer actually drove the
+  randomly-initialized logits down to the entropy floor (training and
+  the loss plumbing work);
+- final eval loss >= floor - eps: a loss BELOW the floor on i.i.d.
+  uniform data is impossible except through target leakage — a broken
+  causal mask (attention peeking at position t+1) or shifted-target
+  misalignment. This is the cheap, always-on canary for exactly the bug
+  class the r17 EP dispatch reshuffles tokens around.
+
     python benchmarks/convergence.py --out CONVERGENCE.json
+    python benchmarks/convergence.py --task lm --out CONVERGENCE_LM.json
 
 Runs on CPU fake devices by default (CI-runnable, no TPU needed).
 """
@@ -32,14 +49,89 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import tempfile
 import time
 
 
+def run_lm(args):
+    """LM entropy-floor leg: train ``--model`` (llama_tiny default; pass
+    llama_moe_tiny + --moe-* mains for the MoE path) on the uniform
+    synthetic token stream and gate the final eval loss against
+    ``ln(vocab_size)``."""
+    import jax
+
+    from pytorch_distributed_training_example_tpu.core.trainer import Trainer
+    from pytorch_distributed_training_example_tpu.utils.config import Config
+
+    model = args.model if args.model != "resnet18" else "llama_tiny"
+    cfg = Config(
+        model=model, dataset="lm", seq_len=args.seq_len,
+        global_batch_size=args.batch_size, epochs=args.epochs,
+        steps_per_epoch=args.steps_per_epoch, lr=args.lr,
+        warmup_epochs=0.0, optimizer="adamw", weight_decay=0.0,
+        precision="fp32", workers=0, evaluate=True, eval_every_epochs=1,
+        moe_dispatch_impl=args.moe_dispatch,
+        moe_capacity_factor=1.0 if args.moe_dispatch == "dropless" else 1.25,
+        moe_ep_dispatch=args.moe_ep_dispatch,
+        checkpoint_dir=tempfile.mkdtemp(prefix="conv_lm_ck_"))
+    t = Trainer(cfg)
+    vocab = getattr(t.bundle.module, "vocab_size", None)
+    assert vocab, f"{model} exposes no vocab_size; cannot place the floor"
+    floor = math.log(vocab)
+
+    curve = []
+    t0 = time.time()
+    for epoch in range(cfg.epochs):
+        t.train_epoch(epoch)
+        avg = t.evaluate(epoch)
+        row = {"epoch": epoch, "step": int(t.state.step),
+               "loss": round(avg.get("loss", float("nan")), 4),
+               "wall_s": round(time.time() - t0, 1)}
+        curve.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    t.metric_logger.close()
+
+    final_loss = curve[-1]["loss"] if curve else float("nan")
+    out = {
+        "task": ("synthetic LM, tokens i.i.d. uniform over the vocab "
+                 "(data/datasets.py SyntheticTokenDataset) — entropy floor "
+                 "= ln(vocab) exactly; loss below the floor implies target "
+                 "leakage (causal mask / target shift)"),
+        "model": model,
+        "vocab_size": vocab,
+        "entropy_floor_nats": round(floor, 4),
+        "floor_margin": args.floor_margin,
+        "floor_eps": args.floor_eps,
+        "seq_len": args.seq_len,
+        "global_batch": args.batch_size,
+        "steps_per_epoch": args.steps_per_epoch,
+        "epochs": args.epochs,
+        "lr": args.lr,
+        "moe_dispatch_impl": args.moe_dispatch,
+        "moe_ep_dispatch": args.moe_ep_dispatch,
+        "devices": jax.device_count(),
+        "backend": jax.default_backend(),
+        "final_loss": final_loss,
+        "ok": (final_loss == final_loss  # NaN guard
+               and floor - args.floor_eps <= final_loss
+               <= floor + args.floor_margin),
+        "curve": curve,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("final_loss", "entropy_floor_nats", "ok")}))
+    return 0 if out["ok"] else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
+    p.add_argument("--task", default="vision", choices=["vision", "lm"],
+                   help="vision: ResNet accuracy-threshold artifact; lm: "
+                        "LM entropy-floor gate on the uniform token stream")
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--steps-per-epoch", type=int, default=30)
     p.add_argument("--batch-size", type=int, default=128)
@@ -47,10 +139,27 @@ def main(argv=None):
     p.add_argument("--model", default="resnet18")
     p.add_argument("--threshold", type=float, default=0.9)
     p.add_argument("--max-gap", type=float, default=0.10)
-    p.add_argument("--out", default="CONVERGENCE.json")
+    p.add_argument("--seq-len", type=int, default=64,
+                   help="--task lm: sequence length")
+    p.add_argument("--floor-margin", type=float, default=0.10,
+                   help="--task lm: final loss may sit this far ABOVE "
+                        "ln(vocab) (optimizer still closing in)")
+    p.add_argument("--floor-eps", type=float, default=1e-3,
+                   help="--task lm: loss below floor - eps fails (target "
+                        "leakage; fp sum tolerance only)")
+    p.add_argument("--moe-dispatch", default="gather",
+                   choices=["sort", "gather", "einsum", "dropless"],
+                   help="--task lm with an MoE model")
+    p.add_argument("--moe-ep-dispatch", default="replicated",
+                   choices=["replicated", "a2a", "a2a_overlap"],
+                   help="--task lm with an MoE model (dropless only)")
+    p.add_argument("--out", default=None)
     p.add_argument("--tpu", action="store_true",
                    help="run on the default backend instead of CPU fakes")
     args = p.parse_args(argv)
+    if args.out is None:
+        args.out = ("CONVERGENCE_LM.json" if args.task == "lm"
+                    else "CONVERGENCE.json")
 
     if not args.tpu:
         os.environ.setdefault("XLA_FLAGS",
@@ -58,6 +167,19 @@ def main(argv=None):
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if args.task == "lm":
+        if args.epochs == 10 and args.steps_per_epoch == 30:
+            # vision defaults are oversized for the floor gate; the LM leg
+            # converges to ln(V) in a few hundred small-batch steps
+            args.epochs, args.steps_per_epoch = 5, 40
+        if args.batch_size == 128:
+            args.batch_size = 16
+        if args.lr == 0.05:
+            args.lr = 1e-3
+        return run_lm(args)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib

@@ -34,6 +34,11 @@ real bench program:
   GL103  device-to-host transfers (host callbacks / outfeed) baked into
          the compiled step.
   GL104  sharding-constraint coverage per named-scope region.
+  GL105  unattributable all-to-all: every ``all-to-all`` in the compiled
+         step must carry a sanctioned named-scope tag (``moe_*`` for the
+         EP dropless transport, ``attn_ulysses_a2a`` for Ulysses) in its
+         op_name metadata — an untagged a2a evades the EP comms census
+         (``--aot-bytes``) and the per-region profile rollups.
 
 Findings are machine-readable (``--json``) and gated against a reviewed
 suppression baseline (``benchmarks/lint_baseline.json``); each suppression
@@ -72,6 +77,14 @@ INFO = "info"
 # occurrence classifies; nested occurrences resolve to the outer tag.
 MOE_TAG_RE = re.compile(
     r"\bmoe_(router|dispatch|experts_gmm|experts|combine|aux)\b")
+
+# Scopes sanctioned to issue all-to-all (GL105): the MoE EP transport
+# regions and the Ulysses head<->sequence reshard (ops/attention.py). The
+# moe_* alternatives mirror MOE_TAG_RE; cotangent a2as keep the forward
+# scope path inside transpose(...), so backward ops match too.
+A2A_SCOPE_RE = re.compile(
+    r"\b(?:moe_(?:router|dispatch|experts_gmm|experts|combine|aux)"
+    r"|attn_ulysses_a2a)\b")
 
 
 def _norm(s: str) -> str:
@@ -1084,6 +1097,50 @@ def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
     return out
 
 
+_A2A_LINE_RE = re.compile(r"= (?:\([^)]*\)|\S+) all-to-all(?:-start)?\(")
+
+
+def _ir_a2a_scope(hlo, label) -> list[Finding]:
+    """GL105: all-to-all instructions outside sanctioned named scopes.
+
+    The EP comms census (profile_step.collective_byte_census) and the
+    PROFILE_MOE region rollups attribute a2a traffic by named-scope tag;
+    an a2a issued outside ``moe_*`` / ``attn_ulysses_a2a`` scopes lands in
+    ``non_moe`` where the --aot-bytes golden never gates it. -done halves
+    are skipped (same instruction, counted once at -start or the sync op).
+    """
+    out: list[Finding] = []
+    seen: set[str] = set()
+    for line in hlo.splitlines():
+        if not _A2A_LINE_RE.search(line):
+            continue
+        op = re.search(r'op_name="([^"]+)"', line)
+        op_name = op.group(1) if op else ""
+        if op_name and A2A_SCOPE_RE.search(op_name):
+            continue
+        key = _norm(op_name) or "<no-op_name>"
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(
+            Finding(
+                rule="GL105",
+                path=f"<ir:{label}>",
+                line=0,
+                scope="a2a-scope",
+                message=(
+                    "all-to-all outside sanctioned named scopes "
+                    f"(op {op_name or '<untagged>'}) — wrap the call site "
+                    "in jax.named_scope('moe_dispatch'/'attn_ulysses_a2a') "
+                    "so the EP comms census and region rollups can "
+                    "attribute its bytes"
+                ),
+                snippet=f"a2a {key}",
+            )
+        )
+    return out
+
+
 def lint_lowered(
     label: str,
     lowered,
@@ -1103,6 +1160,7 @@ def lint_lowered(
     if bf16_regions:
         findings += _ir_upcast(hlo, label, upcast_bytes)
     findings += _ir_host_transfer(hlo, label)
+    findings += _ir_a2a_scope(hlo, label)
     try:
         asm = lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
             enable_debug_info=True

@@ -19,7 +19,16 @@ bytes" = the sum over the moe_* named-scope regions of one train step
 (everything inside the MoE block: router + dispatch + experts + combine
 + aux), as opposed to the dense trunk (non_moe).
 
+``--ep-sweep`` (r17) benches the dropless EP transports instead: per
+EP degree, the AOT collective byte census at the moe_tiny train-step
+shape (llama_moe_tiny b2 s128 — the golden.json ``... ep2 *`` rows) and
+a measured MoE-block step time at moe_tiny dims under an
+``{"expert": ep}`` mesh for each ``ep_dispatch`` mode. Bytes are
+chipless facts; the ms column is this host's devices (fake CPU devices
+off-chip — relative, not headline, numbers).
+
     python benchmarks/moe_bench.py [--out BENCH_MOE.json]
+    python benchmarks/moe_bench.py --ep-sweep [--ep-degrees 1,2,4]
 """
 
 from __future__ import annotations
@@ -111,6 +120,106 @@ def aot_bytes_rows(impls):
     return rows
 
 
+# moe_tiny block dims (models/llama.py llama_moe_tiny): the EP sweep's
+# measured leg times one MoE block at these dims so the rows line up with
+# the chipless AOT census at the llama_moe_tiny train-step shape.
+TINY = {"d_model": 128, "ffn": 256, "experts": 8, "top_k": 2}
+
+
+def ep_bench_point(T, ep, ep_dispatch):
+    """Slope-timed fwd+bwd of one dropless MoE block at moe_tiny dims
+    under an ``{"expert": ep}`` mesh (first ep local devices)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_example_tpu.core import (
+        mesh as mesh_lib)
+    from pytorch_distributed_training_example_tpu.parallel.moe import (
+        MoEBlock)
+
+    if len(jax.devices()) < ep:
+        return {"tokens": T, "ep": ep, "ep_dispatch": ep_dispatch,
+                "ok": False,
+                "error": f"needs {ep} devices, have {len(jax.devices())}"}
+    mesh = mesh_lib.build_mesh({"expert": ep}, devices=jax.devices()[:ep])
+    block = MoEBlock(TINY["experts"], TINY["ffn"], top_k=TINY["top_k"],
+                     capacity_factor=1.0, dispatch_impl="dropless",
+                     ep_dispatch=ep_dispatch, dtype=jnp.bfloat16,
+                     param_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, T, TINY["d_model"]),
+                          jnp.bfloat16)
+    with mesh_lib.use_mesh(mesh):
+        variables = block.init({"params": jax.random.PRNGKey(1)}, x,
+                               train=False)
+        params = variables["params"]
+
+        def loss_fn(params, x):
+            out, _ = block.apply({"params": params}, x, train=False,
+                                 mutable=["losses"])
+            return jnp.sum(out.astype(jnp.float32)) * 1e-3
+
+        grad_fn = jax.grad(loss_fn, argnums=(0, 1))
+
+        def at_length(L):
+            def body(carry, _):
+                gp, gx = grad_fn(params, x + carry.astype(x.dtype))
+                s = sum(jnp.sum(g.astype(jnp.float32))
+                        for g in jax.tree.leaves(gp))
+                return (s * 1e-30 + jnp.float32(jnp.sum(
+                    gx.astype(jnp.float32)) * 1e-30)).astype(jnp.float32), ()
+
+            @jax.jit
+            def run(c0):
+                c, _ = jax.lax.scan(body, c0, None, length=L)
+                return c
+
+            np.asarray(run(jnp.float32(0)))
+            dt = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(run(jnp.float32(0)))
+                dt = min(dt, time.perf_counter() - t0)
+            return dt
+
+        L1, L2 = 5, 20
+        sec = max(at_length(L2) - at_length(L1), 1e-9) / (L2 - L1)
+    return {"tokens": T, "ep": ep, "ep_dispatch": ep_dispatch,
+            "ms": round(sec * 1e3, 3), "tokens_per_sec": round(T / sec)}
+
+
+def ep_sweep_rows(degrees, modes, T):
+    """Per-EP-degree rows: chipless routed/a2a collective bytes from the
+    AOT census (llama_moe_tiny b2 s128, the golden-gated shape) joined
+    with the measured moe_tiny block step time on this host."""
+    from benchmarks import profile_step
+
+    rows = []
+    for ep in degrees:
+        ep_modes = ["replicated"] if ep == 1 else modes
+        for mode in ep_modes:
+            row = {"ep": ep, "ep_dispatch": mode}
+            try:
+                r = profile_step.aot_report(
+                    "llama_moe_tiny", per_chip_batch=2, seq_len=128,
+                    moe_dispatch_impl="dropless", moe_capacity_factor=1.0,
+                    moe_ep_dispatch=mode, ep_degree=ep)
+                coll = r["collectives"]
+                opb = {op: v["bytes"]
+                       for op, v in coll["by_opcode"].items()}
+                row.update(
+                    routed_mb=round(coll["moe_bytes"] / 1e6, 3),
+                    a2a_mb=round(opb.get("all-to-all", 0) / 1e6, 3),
+                    allgather_mb=round(opb.get("all-gather", 0) / 1e6, 3),
+                    collective_total_mb=round(coll["total_bytes"] / 1e6, 3))
+            except Exception as e:  # chipless leg short on devices, etc.
+                row.update(ok=False, error=str(e)[:200])
+            row.update(ep_bench_point(T, ep, mode))
+            rows.append(row)
+            print(json.dumps(row), file=sys.stderr, flush=True)
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="BENCH_MOE.json")
@@ -118,7 +227,45 @@ def main(argv=None):
     p.add_argument("--aot-impls", default="gather,sort,dropless",
                    help="dispatch impls for the routed-region AOT byte "
                         "section (empty string skips it)")
+    p.add_argument("--ep-sweep", action="store_true",
+                   help="bench the dropless EP transports per EP degree "
+                        "(AOT collective bytes + measured moe_tiny block "
+                        "step time) instead of the dispatch sweep")
+    p.add_argument("--ep-degrees", default="1,2,4",
+                   help="EP degrees for --ep-sweep (must divide the "
+                        "expert count and the local device count)")
+    p.add_argument("--ep-modes", default="replicated,a2a,a2a_overlap",
+                   help="ep_dispatch modes per degree for --ep-sweep")
+    p.add_argument("--ep-tokens", type=int, default=4096,
+                   help="token count for the --ep-sweep measured leg")
     args = p.parse_args(argv)
+    if args.ep_sweep:
+        degrees = [int(x) for x in args.ep_degrees.split(",") if x]
+        if "jax" not in sys.modules and max(degrees) > 1:
+            # chipless hosts: the EP meshes need that many devices, and the
+            # flag only takes effect before jax initializes
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={max(degrees)}")
+        import jax
+
+        rows = ep_sweep_rows(degrees,
+                             [s for s in args.ep_modes.split(",") if s],
+                             args.ep_tokens)
+        out = {
+            "bench": "moe_dropless_ep_dispatch_sweep",
+            "device": jax.devices()[0].device_kind,
+            "dims": {**TINY, "capacity_factor": 1.0},
+            "aot_shape": {"model": "llama_moe_tiny", "per_chip_batch": 2,
+                          "seq_len": 128},
+            "pass": "fwd+bwd (params and input grads)",
+            "timing": "two-trip-count slope, chained scan, best of 3",
+            "rows": rows,
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"rows": rows, "out": args.out}))
+        return 0
     import jax
 
     rows = []

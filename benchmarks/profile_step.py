@@ -391,6 +391,66 @@ def build_op_bytes(hlo_text: str):
     return op_bytes
 
 
+# EP comms census: the collective opcodes whose result buffers carry the
+# MoE transport cost (r17 ep_dispatch A/B). async -start halves are skipped
+# and the transfer charged once at -done, matching build_op_bytes.
+_COLLECTIVE_RE = re.compile(
+    r"^(all-to-all|all-gather|all-reduce|reduce-scatter|collective-permute)"
+    r"(-start|-done)?$")
+
+
+def collective_byte_census(hlo_text: str):
+    """Per-opcode / per-region byte census of the collectives in a compiled
+    module — the chipless EP comms model (PROFILE_MOE.md r17).
+
+    Every collective instruction is charged its HBM result-buffer bytes
+    (``_shape_bytes`` over the printed result shape, alternate-memory
+    components excluded) — a per-device transfer-volume proxy, not a wire
+    model: an all-gather's result is the fully gathered buffer each device
+    materializes, an all-to-all's is the shards it receives. That is the
+    quantity the replicated-vs-a2a dropless decision trades (weight gathers
+    vs token shards), so the rows are comparable across ``ep_dispatch``
+    modes lowered at the same mesh. Attribution to MoE regions reuses the
+    named-scope tags (``_moe_tag``); untagged collectives (grad psum over
+    data axes, ...) land in ``non_moe``.
+
+    Returns ``{"total_bytes", "moe_bytes", "by_opcode": {opcode: {"count",
+    "bytes"}}, "by_region": {region: {"count", "bytes"}}}``. Counts are
+    instruction-level (a collective inside a while body counts once).
+    """
+    line_re = re.compile(
+        r"^\s+(?:ROOT )?%?([\w.\-]+) = (.*?)([a-z][a-z0-9\-]*)\(", re.M)
+    by_opcode: dict[str, dict] = {}
+    by_region: dict[str, dict] = {}
+    total = moe = 0
+    for m in line_re.finditer(hlo_text):
+        _, result_txt, opcode = m.groups()
+        cm = _COLLECTIVE_RE.match(opcode)
+        if not cm or cm.group(2) == "-start":
+            continue
+        base = cm.group(1)
+        b = 0
+        for dt, dims, layout in _SHAPE_LAYOUT_RE.findall(result_txt):
+            if "S(" in (layout or ""):
+                continue
+            b += _shape_bytes(dt, dims)
+        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
+        region = _moe_tag(line) or "non_moe"
+        o = by_opcode.setdefault(base, {"count": 0, "bytes": 0})
+        o["count"] += 1
+        o["bytes"] += b
+        r = by_region.setdefault(region, {"count": 0, "bytes": 0})
+        r["count"] += 1
+        r["bytes"] += b
+        total += b
+        if region != "non_moe":
+            moe += b
+    return {"total_bytes": total, "moe_bytes": moe,
+            "by_opcode": dict(sorted(by_opcode.items())),
+            "by_region": dict(sorted(by_region.items(),
+                                     key=lambda kv: -kv[1]["bytes"]))}
+
+
 def collect_ops(trace_dir: str):
     """Aggregate XLA-op events across all device planes/steps in the dump."""
     from jax.profiler import ProfileData
@@ -426,6 +486,7 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
             moe_dispatch_impl="gather", moe_combine_dtype="fp32",
             moe_router_dtype="fp32", moe_router_impl="reference",
+            moe_ep_dispatch="replicated", moe_ep_overlap_chunks=2,
             steps=3, trace_dir=None, top=25, telemetry=False):
     import jax
 
@@ -444,6 +505,8 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
                     moe_combine_dtype=moe_combine_dtype,
                     moe_router_dtype=moe_router_dtype,
                     moe_router_impl=moe_router_impl,
+                    moe_ep_dispatch=moe_ep_dispatch,
+                    moe_ep_overlap_chunks=moe_ep_overlap_chunks,
                     telemetry=telemetry)
     mesh, state, step, batch = su["mesh"], su["state"], su["step"], su["batch"]
     bundle = su["bundle"]
@@ -556,7 +619,9 @@ def profile(model_name: str, *, image_size=224, per_chip_batch=64,
             "moe_dispatch_impl": moe_dispatch_impl,
             "moe_top_k": moe_top_k,
             "moe_combine_dtype": moe_combine_dtype,
-            "moe_capacity_factor": moe_capacity_factor}
+            "moe_capacity_factor": moe_capacity_factor,
+            "moe_ep_dispatch": moe_ep_dispatch,
+            "moe_ep_overlap_chunks": moe_ep_overlap_chunks}
            if moe_rows else {}),
         "top_ops": [{**r, "ms_per_step": round(r["ms_per_step"], 3)}
                     for r in rows[:top]],
@@ -571,7 +636,10 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
                         attn_impl="auto", moe_capacity_factor=1.0,
                         moe_top_k=2, moe_dispatch_impl="gather",
                         moe_combine_dtype="fp32", moe_router_dtype="fp32",
-                        moe_router_impl="reference"):
+                        moe_router_impl="reference",
+                        moe_ep_dispatch="replicated",
+                        moe_ep_overlap_chunks=2,
+                        mesh_spec: dict | None = None):
     """Chipless abstract train step: the shared lowering front-end.
 
     Builds the SAME program ``bench.setup_step`` times — same registry
@@ -581,6 +649,11 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
     chip. Consumers: ``aot_report`` (per-region byte model, the
     ``--aot-bytes`` gate) and ``graftlint`` IR rules (donation / precision /
     host-transfer / sharding checks on the identical program).
+
+    ``mesh_spec`` overrides the default data-only mesh (e.g.
+    ``{"expert": 2, "data": -1}`` for the EP comms model); the lowering
+    needs that many addressable devices — chipless CLI runs force fake CPU
+    devices via XLA_FLAGS before jax initializes (see ``main``).
 
     Returns a dict with ``step`` (jitted, ``donate_argnums=0``),
     ``abstract_state``, ``abstract_batch``, ``mesh``, ``strategy``, and the
@@ -599,7 +672,7 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
     from pytorch_distributed_training_example_tpu.utils.config import (
         from_preset)
 
-    mesh = mesh_lib.build_mesh({"data": -1})
+    mesh = mesh_lib.build_mesh(mesh_spec or {"data": -1})
     global_batch = per_chip_batch * mesh_lib.dp_size(mesh)
     cfg = from_preset("resnet50_imagenet", global_batch_size=global_batch,
                       precision=precision)
@@ -617,6 +690,8 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
                                    moe_combine_dtype=moe_combine_dtype,
                                    moe_router_dtype=moe_router_dtype,
                                    moe_router_impl=moe_router_impl,
+                                   moe_ep_dispatch=moe_ep_dispatch,
+                                   moe_ep_overlap_chunks=moe_ep_overlap_chunks,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
@@ -658,7 +733,9 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
                remat_policy="nothing", attn_impl="auto",
                moe_capacity_factor=1.0, moe_top_k=2,
                moe_dispatch_impl="gather", moe_combine_dtype="fp32",
-               moe_router_dtype="fp32", moe_router_impl="reference"):
+               moe_router_dtype="fp32", moe_router_impl="reference",
+               moe_ep_dispatch="replicated", moe_ep_overlap_chunks=2,
+               ep_degree=1):
     """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
 
     AOT-lowers the SAME train step bench.py times — same registry model,
@@ -686,7 +763,18 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     excluded wholesale (``build_pallas_interior``): the grid while-loop
     that emulates the kernel on CPU is not part of the target program,
     and the kernel's real HBM charge — operands + results, as for any
-    custom call — is carried by the while instruction's boundary tuple."""
+    custom call — is carried by the while instruction's boundary tuple.
+
+    ``ep_degree > 1`` lowers at an ``{"expert": ep, "data": rest}`` mesh
+    (strategy defaults to the model's ``fsdp_tp`` table — the one that
+    pins ``moe/experts/w_*`` to the expert axis) and the ``collectives``
+    census becomes the EP comms model: per-opcode/per-region bytes that
+    the a2a-vs-replicated golden rows gate (``check_regression.py
+    --aot-bytes``)."""
+    mesh_spec = None
+    if ep_degree > 1:
+        mesh_spec = {"expert": ep_degree, "data": -1}
+        strategy = strategy or "fsdp_tp"
     built = build_abstract_step(
         model_name, per_chip_batch=per_chip_batch, precision=precision,
         seq_len=seq_len, strategy=strategy, remat=remat,
@@ -695,7 +783,10 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         moe_dispatch_impl=moe_dispatch_impl,
         moe_combine_dtype=moe_combine_dtype,
         moe_router_dtype=moe_router_dtype,
-        moe_router_impl=moe_router_impl)
+        moe_router_impl=moe_router_impl,
+        moe_ep_dispatch=moe_ep_dispatch,
+        moe_ep_overlap_chunks=moe_ep_overlap_chunks,
+        mesh_spec=mesh_spec)
     import jax
 
     from pytorch_distributed_training_example_tpu.core import (
@@ -758,8 +849,12 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         "moe_router_dtype": moe_router_dtype,
         "moe_router_impl": moe_router_impl,
         "moe_capacity_factor": moe_capacity_factor,
+        "moe_ep_dispatch": moe_ep_dispatch,
+        "moe_ep_overlap_chunks": moe_ep_overlap_chunks,
+        "ep_degree": ep_degree,
         "xla_flops_per_step": ca.get("flops"),
         "xla_bytes_accessed": ca.get("bytes accessed"),
+        "collectives": collective_byte_census(hlo_text),
         "regions": dict(sorted(regions.items(),
                                key=lambda kv: -kv[1]["gbytes_modeled"])),
     }
@@ -786,6 +881,20 @@ def main(argv=None):
     p.add_argument("--moe-router-impl", default="reference",
                    choices=["reference", "fused"])
     p.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    p.add_argument("--moe-ep-dispatch", default="replicated",
+                   choices=["replicated", "a2a", "a2a_overlap"],
+                   dest="moe_ep_dispatch",
+                   help="dropless EP transport (parallel/moe.py); with "
+                        "--aot --ep N the collectives census becomes the "
+                        "chipless EP comms model")
+    p.add_argument("--moe-ep-overlap-chunks", type=int, default=2,
+                   dest="moe_ep_overlap_chunks",
+                   help="a2a_overlap double-buffer windows over the token "
+                        "dim (chunk count reaches the lowered program)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree for --aot: lower at an "
+                        "{expert: N, data: rest} mesh (forces N fake CPU "
+                        "host devices when run chipless)")
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--telemetry", action="store_true",
@@ -799,6 +908,12 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="write full JSON here")
     args = p.parse_args(argv)
     if args.aot:
+        if args.ep > 1 and "jax" not in sys.modules:
+            # Chipless EP lowering needs ep addressable devices; must land
+            # before the first jax import in this process.
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={args.ep}")
         res = aot_report(args.model, per_chip_batch=args.per_chip_batch,
                          precision=args.precision, seq_len=args.seq_len,
                          strategy=args.strategy, remat=args.remat,
@@ -809,7 +924,10 @@ def main(argv=None):
                          moe_dispatch_impl=args.moe_dispatch,
                          moe_combine_dtype=args.moe_combine,
                          moe_router_dtype=args.moe_router_dtype,
-                         moe_router_impl=args.moe_router_impl)
+                         moe_router_impl=args.moe_router_impl,
+                         moe_ep_dispatch=args.moe_ep_dispatch,
+                         moe_ep_overlap_chunks=args.moe_ep_overlap_chunks,
+                         ep_degree=args.ep)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(res, f, indent=1)
@@ -826,6 +944,8 @@ def main(argv=None):
                   moe_combine_dtype=args.moe_combine,
                   moe_router_dtype=args.moe_router_dtype,
                   moe_router_impl=args.moe_router_impl,
+                  moe_ep_dispatch=args.moe_ep_dispatch,
+                  moe_ep_overlap_chunks=args.moe_ep_overlap_chunks,
                   steps=args.steps, top=args.top, telemetry=args.telemetry)
     if args.out:
         with open(args.out, "w") as f:

@@ -90,6 +90,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router softmax/top-k/gates: reference XLA chain "
                         "(default) or the fused single-pass Pallas kernel "
                         "(ops/fused_router.py)")
+    p.add_argument("--moe-ep-dispatch", default=None, dest="moe_ep_dispatch",
+                   choices=["replicated", "a2a", "a2a_overlap"],
+                   help="dropless expert-parallel transport: replicated "
+                        "(every device runs all experts), a2a (all-to-all "
+                        "token shards to local expert weights), or "
+                        "a2a_overlap (chunked a2a double-buffered against "
+                        "the grouped matmul)")
+    p.add_argument("--moe-ep-overlap-chunks", type=int, default=None,
+                   dest="moe_ep_overlap_chunks",
+                   help="a2a_overlap double-buffer windows over the token "
+                        "dim (>= 2 overlaps; the last window may be torn)")
     p.add_argument("--dropout", type=float, default=None,
                    help="model dropout rate (families that support it)")
     p.add_argument("--tensorboard-dir", type=str, default=None,

@@ -142,6 +142,8 @@ class Trainer:
             moe_combine_dtype=cfg.moe_combine_dtype,
             moe_router_dtype=cfg.moe_router_dtype,
             moe_router_impl=cfg.moe_router_impl,
+            moe_ep_dispatch=cfg.moe_ep_dispatch,
+            moe_ep_overlap_chunks=cfg.moe_ep_overlap_chunks,
             logits_dtype=self.policy.logits_dtype)
 
         # data ------------------------------------------------------------
@@ -163,29 +165,48 @@ class Trainer:
                     f"{int(head.max())} >= model vocab {vocab} — wrong "
                     f"--model / --data-path pairing?")
         nproc = jax.process_count()
-        if cfg.global_batch_size % max(nproc, 1):
-            raise ValueError("global batch size must divide evenly across hosts")
         dp = mesh_lib.dp_size(self.mesh)
         if cfg.global_batch_size % dp:
             raise ValueError(
                 f"--batch-size {cfg.global_batch_size} must be divisible by the "
                 f"data-parallel degree {dp} (mesh data x fsdp); e.g. use "
                 f"{(cfg.global_batch_size // dp + 1) * dp}")
+        if nproc <= dp:
+            if cfg.global_batch_size % max(nproc, 1):
+                raise ValueError(
+                    "global batch size must divide evenly across hosts")
+            loader_shards, loader_rank = nproc, jax.process_index()
+        else:
+            # Model/expert-parallel-only hosts (dp < process count): the
+            # batch dim replicates across some or all processes, and
+            # make_array_from_process_local_data assumes every process in a
+            # replica group supplies IDENTICAL rows. Shard the sample stream
+            # by the process's data-parallel coordinate (device order is
+            # dp-major), not its process index — otherwise each host feeds
+            # its own rows into a "replicated" array and devices silently
+            # compute on inconsistent copies.
+            if nproc % dp:
+                raise ValueError(
+                    f"process count {nproc} must be a multiple of the "
+                    f"data-parallel degree {dp} (mesh data x fsdp) so every "
+                    "host maps to one dp replica group")
+            loader_shards = dp
+            loader_rank = jax.process_index() * dp // nproc
         if cfg.grad_accum_steps > 1 and cfg.global_batch_size % (
                 dp * cfg.grad_accum_steps):
             raise ValueError(
                 f"--batch-size {cfg.global_batch_size} must be divisible by "
                 f"data-parallel degree ({dp}) x --grad-accum "
                 f"({cfg.grad_accum_steps})")
-        self.local_batch = cfg.global_batch_size // nproc
+        self.local_batch = cfg.global_batch_size // loader_shards
         train_sampler = sampler_lib.ShardedSampler(
-            len(self.train_data), nproc, jax.process_index(), shuffle=True,
+            len(self.train_data), loader_shards, loader_rank, shuffle=True,
             seed=cfg.seed, drop_last=True)
         self.train_loader = self._make_train_loader(train_sampler)
         self.eval_loader = loader_lib.DataLoader(
             self.eval_data, self.local_batch,
-            sampler_lib.ShardedSampler(len(self.eval_data), nproc,
-                                       jax.process_index(), shuffle=False),
+            sampler_lib.ShardedSampler(len(self.eval_data), loader_shards,
+                                       loader_rank, shuffle=False),
             num_workers=cfg.workers, drop_last=False)
 
         self.steps_per_epoch = len(self.train_loader)

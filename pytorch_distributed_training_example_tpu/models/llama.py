@@ -157,6 +157,8 @@ class LlamaBlock(nn.Module):
     moe_combine_dtype: Any = None      # None -> fp32 combine (exact)
     moe_router_dtype: Any = None       # None -> fp32 logits matmul (exact)
     moe_router_impl: str = "reference"  # reference | fused (ops/fused_router)
+    moe_ep_dispatch: str = "replicated"  # replicated|a2a|a2a_overlap (dropless)
+    moe_ep_overlap_chunks: int = 2      # a2a_overlap double-buffer windows
     sp: bool = False
 
     @nn.compact
@@ -184,6 +186,8 @@ class LlamaBlock(nn.Module):
                          combine_dtype=self.moe_combine_dtype,
                          router_dtype=self.moe_router_dtype,
                          router_impl=self.moe_router_impl,
+                         ep_dispatch=self.moe_ep_dispatch,
+                         ep_overlap_chunks=self.moe_ep_overlap_chunks,
                          dtype=self.dtype,
                          param_dtype=self.param_dtype, name="moe")(h, train)
         else:
@@ -245,6 +249,8 @@ class Llama(nn.Module):
     moe_combine_dtype: Any = None
     moe_router_dtype: Any = None
     moe_router_impl: str = "reference"
+    moe_ep_dispatch: str = "replicated"
+    moe_ep_overlap_chunks: int = 2
     sp: bool = False
     logits_dtype: Any = jnp.float32  # storage dtype; loss upcasts per-element
 
@@ -289,7 +295,9 @@ class Llama(nn.Module):
             moe_dispatch_impl=self.moe_dispatch_impl,
             moe_combine_dtype=self.moe_combine_dtype,
             moe_router_dtype=self.moe_router_dtype,
-            moe_router_impl=self.moe_router_impl, sp=self.sp)
+            moe_router_impl=self.moe_router_impl,
+            moe_ep_dispatch=self.moe_ep_dispatch,
+            moe_ep_overlap_chunks=self.moe_ep_overlap_chunks, sp=self.sp)
         if self.scan_layers:
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts

@@ -50,6 +50,8 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
                  moe_combine_dtype: str = "fp32",
                  moe_router_dtype: str = "fp32",
                  moe_router_impl: str = "reference",
+                 moe_ep_dispatch: str = "replicated",
+                 moe_ep_overlap_chunks: int = 2,
                  logits_dtype=jnp.float32) -> ModelBundle:
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {list_models()}")
@@ -80,6 +82,8 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
         moe_top_k=moe_top_k, moe_dispatch_impl=moe_dispatch_impl,
         moe_combine_dtype=moe_combine_dtype,
         moe_router_dtype=moe_router_dtype, moe_router_impl=moe_router_impl,
+        moe_ep_dispatch=moe_ep_dispatch,
+        moe_ep_overlap_chunks=moe_ep_overlap_chunks,
         logits_dtype=logits_dtype,
     )
 
@@ -89,15 +93,30 @@ def create_model(name: str, *, num_classes: int = 1000, image_size: int = 224,
 _MOE_COMBINE_DTYPES = {"fp32": None, "bf16": jnp.bfloat16}
 _MOE_ROUTER_IMPLS = ("reference", "fused")
 _MOE_DISPATCH_IMPLS = ("sort", "gather", "einsum", "dropless")
+_MOE_EP_DISPATCH = ("replicated", "a2a", "a2a_overlap")
 
 
 def _moe_kwargs(moe_capacity_factor, moe_top_k, moe_dispatch_impl,
                 moe_combine_dtype, moe_router_dtype="fp32",
-                moe_router_impl="reference"):
+                moe_router_impl="reference", moe_ep_dispatch="replicated",
+                moe_ep_overlap_chunks=2):
     if moe_dispatch_impl not in _MOE_DISPATCH_IMPLS:
         raise ValueError(
             f"unknown moe_dispatch_impl {moe_dispatch_impl!r}; "
             f"have {list(_MOE_DISPATCH_IMPLS)}")
+    if moe_ep_dispatch not in _MOE_EP_DISPATCH:
+        raise ValueError(
+            f"unknown moe_ep_dispatch {moe_ep_dispatch!r}; "
+            f"have {list(_MOE_EP_DISPATCH)}")
+    if moe_ep_dispatch != "replicated" and moe_dispatch_impl != "dropless":
+        raise ValueError(
+            f"moe_ep_dispatch={moe_ep_dispatch!r} requires "
+            f"moe_dispatch_impl='dropless' (got {moe_dispatch_impl!r}); the "
+            "capacity-dropped impls shard through GSPMD alone")
+    if int(moe_ep_overlap_chunks) < 1:
+        raise ValueError(
+            f"moe_ep_overlap_chunks must be >= 1 "
+            f"(got {moe_ep_overlap_chunks})")
     if moe_combine_dtype not in _MOE_COMBINE_DTYPES:
         raise ValueError(
             f"unknown moe_combine_dtype {moe_combine_dtype!r}; "
@@ -115,7 +134,9 @@ def _moe_kwargs(moe_capacity_factor, moe_top_k, moe_dispatch_impl,
                 moe_dispatch_impl=moe_dispatch_impl,
                 moe_combine_dtype=_MOE_COMBINE_DTYPES[moe_combine_dtype],
                 moe_router_dtype=_MOE_COMBINE_DTYPES[moe_router_dtype],
-                moe_router_impl=moe_router_impl)
+                moe_router_impl=moe_router_impl,
+                moe_ep_dispatch=moe_ep_dispatch,
+                moe_ep_overlap_chunks=int(moe_ep_overlap_chunks))
 
 
 @register("vit_b16")
@@ -238,6 +259,7 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
                     attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
                     moe_dispatch_impl="gather", moe_combine_dtype="fp32",
                     moe_router_dtype="fp32", moe_router_impl="reference",
+                    moe_ep_dispatch="replicated", moe_ep_overlap_chunks=2,
                     logits_dtype, **_):
     from pytorch_distributed_training_example_tpu.models import llama
 
@@ -250,7 +272,9 @@ def _llama_moe_tiny(*, seq_len, dtype, param_dtype, remat,
                                                 moe_dispatch_impl,
                                                 moe_combine_dtype,
                                                 moe_router_dtype,
-                                                moe_router_impl))
+                                                moe_router_impl,
+                                                moe_ep_dispatch,
+                                                moe_ep_overlap_chunks))
     # MFU basis = ACTIVE params (top-2 experts), not the full expert stack
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
@@ -262,6 +286,7 @@ def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                attn_impl="auto", moe_capacity_factor=1.25, moe_top_k=2,
                moe_dispatch_impl="gather", moe_combine_dtype="fp32",
                moe_router_dtype="fp32", moe_router_impl="reference",
+               moe_ep_dispatch="replicated", moe_ep_overlap_chunks=2,
                logits_dtype, **_):
     """Bench-scale MoE (llama trunk, 8 experts top-2, ~520M total): the
     e2e EP perf row on the real chip (BENCH_MOE.json e2e, BASELINE.md)."""
@@ -276,7 +301,9 @@ def _llama_moe(*, seq_len, dtype, param_dtype, remat, remat_policy="nothing",
                                                 moe_dispatch_impl,
                                                 moe_combine_dtype,
                                                 moe_router_dtype,
-                                                moe_router_impl))
+                                                moe_router_impl,
+                                                moe_ep_dispatch,
+                                                moe_ep_overlap_chunks))
     return _lm_bundle(module, llama.TP_RULES, seq_len,
                       llama.num_params_active)
 

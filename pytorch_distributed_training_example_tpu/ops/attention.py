@@ -421,18 +421,23 @@ def ulysses_attention(
         return out[:, :, :H]
 
     def local_fn(q, k, v):
+        # The named scope is load-bearing: graftlint GL105 sanctions
+        # all-to-all ops in the lowered step by scope tag (moe_* or
+        # attn_ulysses_a2a) — an untagged a2a is flagged as unattributable.
         # [B, S/c, H', D] -> all_to_all -> [B, S, H'/c, D]
         def seq_to_heads(x):
             if x.shape[2] % c:   # GQA KV with fewer heads than shards
                 x = _repeat_kv(x, c)
-            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                                      tiled=True)
+            with jax.named_scope("attn_ulysses_a2a"):
+                return jax.lax.all_to_all(x, axis, split_axis=2,
+                                          concat_axis=1, tiled=True)
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
         out = dot_product_attention(qh, kh, vh, causal=causal)
         # [B, S, H'/c, D] -> back to [B, S/c, H', D]
-        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
-                                  tiled=True)
+        with jax.named_scope("attn_ulysses_a2a"):
+            return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
 
     spec = P(batch_axes, axis, h_ax, None)
     return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),

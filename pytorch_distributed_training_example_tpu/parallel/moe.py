@@ -34,10 +34,28 @@ regions are tagged with ``jax.named_scope`` (``moe_router`` /
 ``moe_experts_gmm`` inside the dropless kernel) so
 ``benchmarks/profile_step.py`` can attribute device time per region from
 an xplane trace (PROFILE_MOE.md).
+
+The dropless path additionally supports **expert-parallel sharded
+execution** (``ep_dispatch``, r17): instead of replicated-pinning the sorted
+tokens and all-gathering the expert weights every step, the contiguous
+per-expert segments are all-to-all'd to the devices that own the experts
+(weights stay sharded ``P('expert', None, None)`` per EP_RULES) and
+``gmm()`` runs against LOCAL weights only, with a device-local tile table
+derived from the local segment counts. ``"a2a_overlap"`` splits the token
+dim into double-buffered chunks so the next chunk's all-to-all is issued
+before the current chunk's grouped matmul — program order XLA's async
+scheduler can overlap on a chip. Both variants are bitwise-identical to the
+replicated path (same rows, same weights, same single-dot full-``d``
+contraction per row; tested in tests/test_moe_dropless.py). This is what
+makes E ≫ devices representable: per-device expert memory is ``E/ep``
+weight blocks instead of all ``E``.
 """
 
 from __future__ import annotations
 
+import functools
+import json
+import os
 import warnings
 from typing import Any, NamedTuple
 
@@ -50,7 +68,79 @@ from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 
 BATCH = mesh_lib.BATCH_AXES
 
+#: Valid values for ``MoEBlock.ep_dispatch`` (dropless only).
+EP_DISPATCH_IMPLS = ("replicated", "a2a", "a2a_overlap")
+
+#: jsonl path: trace-time a2a chunk geometry (static shapes only, so two
+#: same-seed runs produce byte-identical logs — asserted by dryrun leg 17).
+A2A_CHUNK_LOG_ENV = "PDTX_A2A_CHUNK_LOG"
+
+#: "native" (lax.all_to_all; default — verified correct under the gloo CPU
+#: cross-process backend) or "ppermute" (decomposed fallback hatch).
+EP_A2A_IMPL_ENV = "PDTX_EP_A2A_IMPL"
+
 _capacity_clamp_warned = False
+_ep_fallback_warned = False
+
+
+def _warn_ep_fallback(ep_dispatch, num_experts, n_rows, ep):
+    """One-time trace-time warning when a requested sharded EP dispatch
+    falls back to replicated because the shape doesn't tile the EP axis."""
+    global _ep_fallback_warned
+    if _ep_fallback_warned:
+        return
+    _ep_fallback_warned = True
+    warnings.warn(
+        f"MoE ep_dispatch={ep_dispatch!r} requested but E={num_experts} or "
+        f"sorted rows kT={n_rows} does not divide the expert mesh axis "
+        f"(size {ep}); falling back to the replicated dropless path. "
+        f"(warned once per process)", RuntimeWarning, stacklevel=3)
+
+
+def _ep_degree(ep_dispatch: str, num_experts: int, n_rows: int) -> int:
+    """Static EP fan-out for the dropless dispatch: the expert mesh axis
+    size when the sharded path can run, else 1 (replicated execution).
+
+    All inputs are trace-time static; init-time tracing outside
+    ``use_mesh`` (mesh None) collapses to 1 like the attention dispatcher
+    does, so param structure is identical across paths.
+    """
+    if ep_dispatch not in EP_DISPATCH_IMPLS:
+        raise ValueError(f"unknown ep_dispatch {ep_dispatch!r}; "
+                         f"have {list(EP_DISPATCH_IMPLS)}")
+    if ep_dispatch == "replicated":
+        return 1
+    mesh = mesh_lib.current_mesh()
+    ep = mesh.shape.get("expert", 1) if mesh is not None else 1
+    if ep <= 1:
+        return 1
+    if num_experts % ep or n_rows % ep:
+        _warn_ep_fallback(ep_dispatch, num_experts, n_rows, ep)
+        return 1
+    return ep
+
+
+def _log_a2a_chunks(scope: str, mode: str, *, ep: int, rows_per_device: int,
+                    d_model: int, chunk_rows, dtype, impl: str) -> None:
+    """Append the static a2a geometry to ``A2A_CHUNK_LOG_ENV`` (trace time).
+
+    Everything here is compile-time static (no data, no clocks), so the log
+    is byte-identical across same-seed runs — the dryrun leg's determinism
+    contract for the sharded dispatch.
+    """
+    path = os.environ.get(A2A_CHUNK_LOG_ENV)
+    if not path:
+        return
+    itemsize = jnp.dtype(dtype).itemsize
+    row = {"scope": scope, "mode": mode, "ep": ep,
+           "rows_per_device": int(rows_per_device), "d_model": int(d_model),
+           "n_chunks": len(chunk_rows),
+           "chunk_rows": [int(w) for w in chunk_rows],
+           "send_bytes_per_chunk": [int(ep * w * d_model * itemsize)
+                                    for w in chunk_rows],
+           "dtype": str(jnp.dtype(dtype).name), "impl": impl}
+    with open(path, "a") as fh:
+        fh.write(json.dumps(row, sort_keys=True) + "\n")
 
 
 def _warn_capacity_clamp(capacity_factor, T, top_k, num_experts):
@@ -70,6 +160,172 @@ def _warn_capacity_clamp(capacity_factor, T, top_k, num_experts):
         f"DROPPED. Raise capacity_factor / batch size, or switch to "
         f"dispatch_impl='dropless' (no capacity, no drops). "
         f"(warned once per process)", RuntimeWarning, stacklevel=3)
+
+
+def _ep_sharded_ffn(x_loc, w_up, w_down, starts, counts, *, ep, a2a_impl):
+    """shard_map body (manual over 'expert'): a2a dispatch + LOCAL gmm.
+
+    ``x_loc`` is this device's contiguous ``[R, d]`` slice of the globally
+    expert-sorted ``[kT, d]`` array (R = kT/ep); ``w_up``/``w_down`` are the
+    local ``[E/ep, ...]`` expert shards; ``starts``/``counts`` the GLOBAL
+    ``[E]`` segment table (replicated — O(E) ints).
+
+    Two contiguity invariants carry the whole formulation:
+
+    1. a contiguous slice of the sorted array splits into ≤ ep contiguous
+       destination chunks with boundaries ``clip(starts[q·E/ep] − p·R, 0,
+       R)`` — so the send buffer is ep static windows, no scatter;
+    2. source-major concatenation of the valid received rows IS the global
+       sorted order restricted to this device's experts — so ONE compaction
+       gather yields an expert-sorted local array and the unchanged
+       ``grouped_ffn`` kernel runs against it with the device-local tile
+       table built from ``counts[p·E/ep : (p+1)·E/ep]``.
+
+    The local row buffer is padded to the static worst case kT (all tokens
+    routed here); padding rows are zero, steered into the last local
+    expert's segment (zero rows contribute zero to outputs and to dw), and
+    never scattered back. Per-row outputs are bitwise-identical to the
+    replicated path: same rows, same weights, and the kernel contracts the
+    full ``d`` dim in one fp32-accumulated dot regardless of tile layout.
+    """
+    from pytorch_distributed_training_example_tpu.ops import (
+        collectives, grouped_matmul as gmm_lib)
+
+    p = jax.lax.axis_index("expert")
+    R = x_loc.shape[0]
+    E_l = w_up.shape[0]
+    Tk = R * ep
+    st_ext = jnp.concatenate([starts, jnp.array([Tk], starts.dtype)])
+    ar = jnp.arange(R)
+    with jax.named_scope("moe_dispatch"):
+        # Invariant 1: my rows' destination-chunk boundaries.
+        bounds = jnp.clip(st_ext[::E_l][:ep + 1] - p * R, 0, R)   # [ep+1]
+        pos = bounds[:-1, None] + ar[None, :]
+        valid = pos < bounds[1:, None]
+        send = jnp.where(valid[..., None],
+                         x_loc[jnp.clip(pos, 0, R - 1)], 0)       # [ep, R, d]
+        recv = collectives.all_to_all_blocks(send, "expert", impl=a2a_impl)
+        # Source-side geometry: source s sent me its rows [lo_s, hi_s).
+        s_ar = jnp.arange(ep)
+        lo = jnp.clip(st_ext[p * E_l] - s_ar * R, 0, R)
+        hi = jnp.clip(st_ext[(p + 1) * E_l] - s_ar * R, 0, R)
+        seg = hi - lo
+        off = jnp.concatenate([jnp.zeros((1,), seg.dtype), jnp.cumsum(seg)])
+        T_l = off[-1]                       # my valid token count (traced)
+        # Invariant 2: compaction gather -> expert-sorted local rows.
+        j = jnp.arange(Tk)
+        sj = jnp.clip(jnp.searchsorted(off, j, side="right") - 1, 0, ep - 1)
+        flat = recv.reshape(Tk, -1)
+        gidx = jnp.clip(sj * R + (j - off[sj]), 0, Tk - 1)
+        x_l = jnp.where((j < T_l)[:, None], flat[gidx], 0)        # [kT, d]
+        # Device-local tile table: local counts, last segment inflated to
+        # absorb the zero padding so the segments tile [0, kT) exactly.
+        ct_l = jax.lax.dynamic_slice(counts, (p * E_l,), (E_l,))
+        ct_l = ct_l.at[-1].add((Tk - T_l).astype(ct_l.dtype))
+        st_l = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(ct_l)[:-1].astype(jnp.int32)])
+    with jax.named_scope("moe_experts_gmm"):
+        y_l = gmm_lib.grouped_ffn(x_l, w_up, w_down, st_l, ct_l)
+    with jax.named_scope("moe_dispatch"):
+        # Inverse transport: return chunk for source s = rows [off_s,
+        # off_s + seg_s) of the local result, then reassemble my slice.
+        bidx = jnp.clip(off[:-1, None] + ar[None, :], 0, Tk - 1)
+        bvalid = ar[None, :] < seg[:, None]
+        back = jnp.where(bvalid[..., None], y_l[bidx], 0)         # [ep, R, d]
+        rb = collectives.all_to_all_blocks(back, "expert", impl=a2a_impl)
+        qr = jnp.clip(jnp.searchsorted(bounds, ar, side="right") - 1,
+                      0, ep - 1)
+        return rb.reshape(Tk, -1)[qr * R + (ar - bounds[qr])]     # [R, d]
+
+
+def _ep_overlap_ffn(x_loc, w_up, w_down, starts, counts, *, ep, chunk_rows,
+                    a2a_impl):
+    """shard_map body: double-buffered chunked a2a/gmm overlap variant.
+
+    Same transport geometry as :func:`_ep_sharded_ffn`, but the
+    per-destination ``R`` rows are split into ``chunk_rows`` windows (the
+    last may be torn) and the loop is unrolled so chunk ``c+1``'s dispatch
+    all-to-all is issued BEFORE chunk ``c``'s grouped matmul — independent
+    ops in program order that XLA's async scheduler can overlap on a chip
+    (a2a-start / gmm / a2a-done). Each received chunk is locally re-sorted
+    by expert (ids derived from the static geometry, no extra metadata on
+    the wire) and fed to ``gmm`` with chunk-local counts; per-chunk dw
+    contributions sum under autodiff.
+    """
+    from pytorch_distributed_training_example_tpu.ops import (
+        collectives, grouped_matmul as gmm_lib)
+
+    p = jax.lax.axis_index("expert")
+    R = x_loc.shape[0]
+    E_l = w_up.shape[0]
+    Tk = R * ep
+    Rc = chunk_rows[0] if chunk_rows else R
+    st_ext = jnp.concatenate([starts, jnp.array([Tk], starts.dtype)])
+    bounds = jnp.clip(st_ext[::E_l][:ep + 1] - p * R, 0, R)
+    s_ar = jnp.arange(ep)
+    lo = jnp.clip(st_ext[p * E_l] - s_ar * R, 0, R)
+    hi = jnp.clip(st_ext[(p + 1) * E_l] - s_ar * R, 0, R)
+    seg = hi - lo
+
+    def make_send(c, w):
+        jr = jnp.arange(w)
+        pos = bounds[:-1, None] + c * Rc + jr[None, :]
+        valid = pos < bounds[1:, None]
+        return jnp.where(valid[..., None],
+                         x_loc[jnp.clip(pos, 0, R - 1)], 0)       # [ep, w, d]
+
+    def expert_chunk(c, recv):
+        """Local FFN on one received chunk: geometry-derived expert ids,
+        chunk-local stable sort, gmm with chunk-local counts, inverse."""
+        w = recv.shape[1]
+        jr = jnp.arange(w)
+        o = lo[:, None] + c * Rc + jr[None, :]     # source-slice offsets
+        valid = (c * Rc + jr[None, :]) < seg[:, None]
+        g = s_ar[:, None] * R + o                  # global sorted index
+        eid = jnp.searchsorted(st_ext[1:], g, side="right")
+        eid_l = jnp.clip(eid - p * E_l, 0, E_l - 1)
+        # Invalid (padding) rows are zeroed and steered into the last
+        # local expert's segment: zero rows through any expert are zero.
+        eid_l = jnp.where(valid, eid_l, E_l - 1)
+        xs_c = jnp.where(valid[..., None], recv, 0).reshape(ep * w, -1)
+        keys = eid_l.reshape(-1).astype(jnp.int32)
+        perm = jnp.argsort(keys, stable=True)
+        ct_c = jnp.bincount(keys, length=E_l).astype(jnp.int32)
+        st_c = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(ct_c)[:-1].astype(jnp.int32)])
+        with jax.named_scope("moe_experts_gmm"):
+            y_sorted = gmm_lib.grouped_ffn(xs_c[perm], w_up, w_down,
+                                           st_c, ct_c)
+        y_c = jnp.zeros_like(y_sorted).at[perm].set(y_sorted)
+        return jnp.where(valid.reshape(-1)[:, None], y_c,
+                         0).reshape(ep, w, -1)
+
+    a2a = functools.partial(collectives.all_to_all_blocks, axis="expert",
+                            impl=a2a_impl)
+    n_chunks = len(chunk_rows)
+    with jax.named_scope("moe_dispatch"):
+        sends = [make_send(c, w) for c, w in enumerate(chunk_rows)]
+        recv = [None] * n_chunks
+        recv[0] = a2a(sends[0])
+    y_slice = jnp.zeros((R + 1, x_loc.shape[1]), x_loc.dtype)
+    ar = jnp.arange(R)
+    for c, w in enumerate(chunk_rows):
+        if c + 1 < n_chunks:
+            # Double buffering: next chunk's a2a precedes this chunk's gmm
+            # in program order (the overlap the HLO test inspects).
+            with jax.named_scope("moe_dispatch"):
+                recv[c + 1] = a2a(sends[c + 1])
+        y_c = expert_chunk(c, recv[c])
+        with jax.named_scope("moe_dispatch"):
+            rb = a2a(y_c)                          # [ep, w, d] back to me
+            jr = jnp.arange(w)
+            pos = bounds[:-1, None] + c * Rc + jr[None, :]
+            valid = pos < bounds[1:, None]
+            tgt = jnp.where(valid, pos, R)         # row R = trash
+            y_slice = y_slice.at[tgt.reshape(-1)].set(rb.reshape(ep * w, -1))
+    return y_slice[:R]
 
 
 class ExpertFFN(nn.Module):
@@ -105,12 +361,24 @@ class GroupedExpertFFN(nn.Module):
     lecun_normal, ``param_dtype``), so checkpoints and the
     ``experts/w_(up|down)`` sharding rules (EP_RULES, llama TP_RULES) are
     unchanged when flipping ``dispatch_impl`` to ``"dropless"``.
+
+    ``ep_dispatch`` selects the execution layout (see the module
+    docstring): ``"replicated"`` runs the r14 single-program kernel on the
+    replicated sorted array; ``"a2a"`` shard_maps over the ``expert`` mesh
+    axis — the weight in_specs match EP_RULES exactly, so no resharding —
+    and ``"a2a_overlap"`` additionally splits the transport into
+    ``ep_overlap_chunks`` double-buffered windows. Sharded paths fall back
+    to replicated when the mesh has no expert axis > 1 or the shape does
+    not tile it (one-time warning), keeping init-time tracing and
+    single-device runs on the identical param structure.
     """
 
     num_experts: int
     ffn_dim: int
     dtype: Any
     param_dtype: Any
+    ep_dispatch: str = "replicated"  # "replicated" | "a2a" | "a2a_overlap"
+    ep_overlap_chunks: int = 2       # a2a_overlap double-buffer windows
 
     @nn.compact
     def __call__(self, x_sorted, starts, counts):  # [kT, d], [E], [E]
@@ -122,10 +390,46 @@ class GroupedExpertFFN(nn.Module):
                           (self.num_experts, d, self.ffn_dim), self.param_dtype)
         w_down = self.param("w_down", nn.initializers.lecun_normal(),
                             (self.num_experts, self.ffn_dim, d), self.param_dtype)
-        with jax.named_scope("moe_experts_gmm"):
-            return gmm_lib.grouped_ffn(x_sorted, w_up.astype(self.dtype),
-                                       w_down.astype(self.dtype), starts,
-                                       counts)
+        ep = _ep_degree(self.ep_dispatch, self.num_experts, x_sorted.shape[0])
+        if ep == 1:
+            with jax.named_scope("moe_experts_gmm"):
+                return gmm_lib.grouped_ffn(x_sorted, w_up.astype(self.dtype),
+                                           w_down.astype(self.dtype), starts,
+                                           counts)
+        # Sharded EP execution: manual over 'expert' only; the other mesh
+        # axes are unmentioned (the sorted array is replicated over the
+        # batch axes exactly like the r14 path — shard_map's transpose
+        # handles the unmentioned-axis cotangents, grads oracle-tested).
+        from pytorch_distributed_training_example_tpu.ops import (
+            pallas_compat as _compat)  # noqa: F401  jax.shard_map shim
+        mesh = mesh_lib.current_mesh()
+        a2a_impl = os.environ.get(EP_A2A_IMPL_ENV, "native")
+        R = x_sorted.shape[0] // ep
+        if self.ep_dispatch == "a2a_overlap":
+            n = max(1, min(int(self.ep_overlap_chunks), R))
+            rc = -(-R // n)
+            chunk_rows = tuple(min(rc, R - c * rc) for c in range(n)
+                               if R - c * rc > 0)  # torn last chunk
+            body = functools.partial(_ep_overlap_ffn, ep=ep,
+                                     chunk_rows=chunk_rows, a2a_impl=a2a_impl)
+        else:
+            chunk_rows = (R,)
+            body = functools.partial(_ep_sharded_ffn, ep=ep,
+                                     a2a_impl=a2a_impl)
+        try:
+            scope = "/".join(self.scope.path)
+        except Exception:
+            scope = str(self.name)
+        _log_a2a_chunks(scope, self.ep_dispatch, ep=ep, rows_per_device=R,
+                        d_model=d, chunk_rows=chunk_rows, dtype=self.dtype,
+                        impl=a2a_impl)
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("expert", None), P("expert", None, None),
+                      P("expert", None, None), P(None), P(None)),
+            out_specs=P("expert", None), check_vma=False)
+        return fn(x_sorted, w_up.astype(self.dtype),
+                  w_down.astype(self.dtype), starts, counts)
 
 
 class RouterDense(nn.Module):
@@ -239,9 +543,13 @@ class MoEBlock(nn.Module):
       (ops/grouped_matmul.py); combine is the inverse-permutation gather.
       ``moe_drop_fraction`` sows an exact constant 0.0. Matches the einsum
       oracle at a never-drop capacity factor (tests/test_moe_dropless.py);
-      the kernel runs interpret-mode off-TPU and replicated under GSPMD
-      (sharded EP execution of the kernel itself is a chip A/B follow-up —
-      PROFILE_MOE.md r14).
+      the kernel runs interpret-mode off-TPU. ``ep_dispatch`` selects the
+      execution layout: ``"replicated"`` (r14 default — single-program
+      kernel on the replicated sorted array), ``"a2a"`` (sorted segments
+      all-to-all'd to per-device expert shards, gmm against LOCAL weights
+      only), or ``"a2a_overlap"`` (chunked double-buffered a2a so expert
+      compute hides interconnect latency). All three are bitwise-identical
+      per row; see the module docstring and PROFILE_MOE.md r17 addendum.
 
     ``router_dtype`` sets the logits-matmul precision (``RouterDense``):
     None/fp32 is the exact ST-MoE contract and the default; bf16 halves the
@@ -276,6 +584,11 @@ class MoEBlock(nn.Module):
     combine_dtype: Any = None  # None -> fp32 (exact); bf16 halves combine BW
     router_dtype: Any = None   # None -> fp32 logits matmul (exact); bf16 A/B
     router_impl: str = "reference"  # "reference" | "fused" (Pallas)
+    # Dropless-only EP execution layout (module docstring; r17):
+    # "replicated" = r14 single-program kernel; "a2a" = sharded segments to
+    # per-device expert shards; "a2a_overlap" = chunked double-buffered a2a.
+    ep_dispatch: str = "replicated"
+    ep_overlap_chunks: int = 2
 
     @nn.compact
     def __call__(self, x, train: bool = True):  # x: [B, S, d]
@@ -284,6 +597,11 @@ class MoEBlock(nn.Module):
         tokens = x.reshape(B * S, d)
         T = B * S
         dropless = self.dispatch_impl == "dropless"
+        if self.ep_dispatch != "replicated" and not dropless:
+            raise ValueError(
+                f"ep_dispatch={self.ep_dispatch!r} only applies to "
+                f"dispatch_impl='dropless' (got {self.dispatch_impl!r}); "
+                "the capacity-dropped impls shard through GSPMD alone")
         if dropless:
             # No capacity in the dropless formulation; a never-drop value
             # keeps stats.within_cap trivially all-true (and DCE'd — nothing
@@ -426,17 +744,24 @@ class MoEBlock(nn.Module):
         ``slot = starts[expert] + pos`` and a gather + gate einsum is exact.
         """
         T, d = tokens.shape
+        ep = _ep_degree(self.ep_dispatch, self.num_experts,
+                        stats.order.shape[0])
         with jax.named_scope("moe_dispatch"):
             tok_flat = (stats.order % T).astype(jnp.int32)
             x_sorted = tokens[tok_flat].astype(self.dtype)       # [kT, d]
-            # Replicate the kernel operands: pallas_call does not partition
-            # under GSPMD (the EP-sharded kernel is a chip A/B follow-up),
-            # and the pin also sidesteps the jax 0.4.x sharded-operand
-            # gather miscompile (see _combine).
-            x_sorted = mesh_lib.constrain(x_sorted, P(None, None))
+            # Pin the sorted layout: replicated for the single-program
+            # kernel (pallas_call does not partition under GSPMD, and the
+            # pin also sidesteps the jax 0.4.x sharded-operand gather
+            # miscompile — see _combine); expert-sliced for the sharded EP
+            # paths, matching the shard_map in_specs so GSPMD feeds the
+            # manual region without a reshard.
+            x_sorted = mesh_lib.constrain(
+                x_sorted, P("expert", None) if ep > 1 else P(None, None))
         with jax.named_scope("moe_experts"):
             y_sorted = GroupedExpertFFN(
                 self.num_experts, self.ffn_dim, self.dtype, self.param_dtype,
+                ep_dispatch=self.ep_dispatch,
+                ep_overlap_chunks=self.ep_overlap_chunks,
                 name="experts")(x_sorted, stats.starts, stats.counts)
         with jax.named_scope("moe_combine"):
             cdt = self.combine_dtype or jnp.float32

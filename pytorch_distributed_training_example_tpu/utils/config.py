@@ -54,6 +54,9 @@ class Config:
     moe_combine_dtype: str = "fp32"  # fp32 (exact) | bf16 (combine-BW A/B)
     moe_router_dtype: str = "fp32"  # fp32 (ST-MoE exact) | bf16 (matmul A/B)
     moe_router_impl: str = "reference"  # reference | fused (Pallas kernel)
+    # dropless EP transport: replicated weights | sharded a2a | a2a+gmm overlap
+    moe_ep_dispatch: str = "replicated"  # replicated | a2a | a2a_overlap
+    moe_ep_overlap_chunks: int = 2  # a2a_overlap double-buffer windows
     pp_microbatches: int = 8  # GPipe microbatches (strategy "pp")
     # parallelism (mesh axis sizes; -1 absorbs remaining devices)
     strategy: str = "dp"  # dp | fsdp | fsdp_tp (model-provided tables)

@@ -20,6 +20,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT = os.path.join(REPO, "CONVERGENCE.json")
+ARTIFACT_LM = os.path.join(REPO, "CONVERGENCE_LM.json")
 
 
 def test_convergence_artifact_meets_threshold():
@@ -41,6 +42,46 @@ def test_convergence_artifact_meets_threshold():
         "accuracy curve should end converged", accs)
     assert d["curve"][-1]["loss"] < d["curve"][0]["loss"]
     assert all("gap" in r for r in d["curve"])
+
+
+def test_lm_convergence_artifact_sits_on_entropy_floor():
+    """r17 LM leg: the synthetic token stream is i.i.d. uniform, so the
+    optimal loss is exactly ln(vocab) — the artifact's final eval loss
+    must land inside [floor - eps, floor + margin]. The LOWER bound is
+    the interesting half: loss below the floor on uniform data is only
+    possible via target leakage (broken causal mask / shifted targets),
+    the bug class the EP token reshuffle could reintroduce."""
+    import math
+    with open(ARTIFACT_LM) as f:
+        d = json.load(f)
+    assert d["ok"] is True
+    floor = math.log(d["vocab_size"])
+    assert abs(d["entropy_floor_nats"] - floor) < 1e-3
+    assert d["floor_eps"] <= 0.01 and d["floor_margin"] <= 0.10, (
+        "gate bounds must stay tight", d)
+    assert floor - d["floor_eps"] <= d["final_loss"] <= \
+        floor + d["floor_margin"], d["curve"]
+    assert len(d["curve"]) >= 3, "curve must cover a real horizon"
+    losses = [r["loss"] for r in d["curve"]]
+    assert losses[-1] <= losses[0] + 1e-3, ("loss must not diverge", losses)
+    assert all(l >= floor - d["floor_eps"] for l in losses), (
+        "no epoch may dip below the entropy floor", losses)
+    assert "leakage" in d["task"] and "uniform" in d["task"]
+
+
+@pytest.mark.slow
+def test_lm_convergence_rerun_holds_entropy_floor(tmp_path):
+    """Re-train llama_tiny on the uniform token stream with a reduced
+    budget and assert the two-sided floor gate end to end."""
+    out = tmp_path / "conv_lm.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "convergence.py"),
+         "--task", "lm", "--epochs", "3", "--steps-per-epoch", "30",
+         "--floor-margin", "0.10", "--out", str(out)],
+        capture_output=True, text=True, timeout=1800, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-2000:]
+    d = json.loads(out.read_text())
+    assert d["ok"], d["curve"]
 
 
 @pytest.mark.slow

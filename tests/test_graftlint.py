@@ -407,6 +407,47 @@ def test_ir_sharding_coverage_and_missing(tiny):
     assert gl104 and gl104[0].severity == "error"
 
 
+def _a2a_step(jax, jnp, mesh, scope):
+    """Planted 2-device shard_map step whose body issues one all-to-all,
+    optionally inside ``scope`` (GL105's sanction vocabulary)."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        import contextlib
+        ctx = jax.named_scope(scope) if scope else contextlib.nullcontext()
+        with ctx:
+            return jax.lax.all_to_all(x, "expert", split_axis=0,
+                                      concat_axis=0, tiled=True)
+
+    def step(s, b):
+        y = jax.shard_map(body, mesh=mesh, in_specs=P("expert", None),
+                          out_specs=P("expert", None), check_vma=False)(b)
+        return s, y.astype(jnp.float32).sum()
+
+    return step
+
+
+@pytest.mark.parametrize("scope", [None, "moe_dispatch", "attn_ulysses_a2a"])
+def test_ir_a2a_scope_rule(tiny, scope):
+    """GL105: an untagged all-to-all is an error; the MoE EP transport and
+    Ulysses scopes are sanctioned (their bytes are census-attributable)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    jax, jnp, state, batch = tiny
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("expert",))
+    lowered = jax.jit(_a2a_step(jax, jnp, mesh, scope),
+                      donate_argnums=0).lower(state, batch)
+    found = graftlint.lint_lowered("t", lowered, abstract_state=state)
+    gl105 = [f for f in found if f.rule == "GL105"]
+    if scope is None:
+        assert gl105 and gl105[0].severity == "error"
+        assert gl105[0].scope == "a2a-scope"
+        assert "all-to-all outside sanctioned" in gl105[0].message
+    else:
+        assert gl105 == [], [f.render() for f in gl105]
+
+
 # -- whole-tree gate + baseline workflow ------------------------------------
 
 def test_whole_tree_zero_unbaselined_errors():

@@ -350,3 +350,192 @@ def test_capacity_clamp_warns_once():
                                capacity_factor=0.1,
                                dispatch_impl="dropless")
         blk.init(jax.random.PRNGKey(0), x)
+
+
+# ---- r17: expert-parallel dropless dispatch (ep_dispatch) ----------------
+
+
+def _a2a_blocks_run(mesh, x, impl):
+    from pytorch_distributed_training_example_tpu.ops import collectives
+    from pytorch_distributed_training_example_tpu.ops import (
+        pallas_compat as _compat)  # noqa: F401  jax.shard_map shim
+    from jax.sharding import PartitionSpec as P
+
+    def body(xl):
+        return collectives.all_to_all_blocks(xl, "expert", impl=impl)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("expert"),),
+                       out_specs=P("expert"), check_vma=False)
+    with mesh_lib.use_mesh(mesh):
+        val = jax.jit(fn)(x)
+        grad = jax.jit(jax.grad(
+            lambda a: jnp.sum(jnp.sin(fn(a).astype(jnp.float32)))))(x)
+    return np.asarray(val), np.asarray(grad)
+
+
+def test_a2a_blocks_native_vs_ppermute(devices):
+    """The ppermute fallback (gloo gangs without a real all-to-all) is
+    value-bitwise and grad-close to lax.all_to_all, and both match the
+    block-transpose semantics: out[dst-major] = in[src-major].T."""
+    ep = 4
+    mesh = mesh_lib.build_mesh({"expert": ep, "data": 2})
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((ep * ep, 6, 8)),
+                    jnp.float32)
+    v_nat, g_nat = _a2a_blocks_run(mesh, x, "native")
+    v_pp, g_pp = _a2a_blocks_run(mesh, x, "ppermute")
+    np.testing.assert_array_equal(v_nat, v_pp)
+    np.testing.assert_allclose(g_nat, g_pp, rtol=1e-6, atol=1e-7)
+    # semantics: device p's block q lands on device q as its block p
+    blocks = np.asarray(x).reshape(ep, ep, 6, 8)
+    np.testing.assert_array_equal(
+        v_nat, np.swapaxes(blocks, 0, 1).reshape(ep * ep, 6, 8))
+    # grad of sum-of-sin is elementwise through a permutation: positions
+    # only move, so the cotangent must ride the inverse route exactly
+    np.testing.assert_allclose(g_nat, np.cos(np.asarray(x)), rtol=1e-6,
+                               atol=1e-7)
+
+
+@pytest.mark.parametrize("ep_dispatch,chunks", [
+    ("a2a", 2),
+    ("a2a_overlap", 2),     # even split: R=16 -> [8, 8]
+    ("a2a_overlap", 3),     # torn last window: R=16 -> [6, 6, 4]
+    ("a2a_overlap", 16),    # chunk == single row (degenerate geometry)
+])
+def test_dropless_ep_dispatch_matches_replicated(devices, ep_dispatch,
+                                                 chunks):
+    """Sharded EP execution (a2a tokens to weight shards, local gmm) ==
+    the replicated r14 block, forward and grads, including the torn
+    ragged-last-chunk double-buffer geometries. Tolerance is the
+    block-level contract (PROFILE_MOE.md r17): the gmm itself is bitwise,
+    the surrounding router matmul fuses differently once the mesh is
+    live, giving 1-ulp-scale wobble."""
+    blk_kw = dict(num_experts=4, ffn_dim=32, top_k=2, capacity_factor=1.0,
+                  dispatch_impl="dropless")
+    ref_blk = moe_lib.MoEBlock(**blk_kw)
+    ep_blk = moe_lib.MoEBlock(**blk_kw, ep_dispatch=ep_dispatch,
+                              ep_overlap_chunks=chunks)
+    x = _x(seed=3, b=2, t=16)  # kT=64, ep=4 -> R=16 rows per device
+    params = ref_blk.init(jax.random.PRNGKey(0), x)["params"]
+
+    def apply(blk, p, xx):
+        out, _ = blk.apply({"params": p}, xx,
+                           mutable=["telemetry", "losses"])
+        return out
+
+    ref = apply(ref_blk, params, x)
+    g_ref = jax.grad(lambda p: jnp.sum(apply(ref_blk, p, x) ** 2))(params)
+
+    mesh = mesh_lib.build_mesh({"expert": 4, "data": 2})
+    shardings = sharding_lib.make_shardings(params, mesh, moe_lib.EP_RULES)
+    p_sh = jax.tree.map(jax.device_put, params, shardings)
+    with mesh_lib.use_mesh(mesh):
+        out = jax.jit(lambda p: apply(ep_blk, p, x))(p_sh)
+        g_out = jax.jit(jax.grad(
+            lambda p: jnp.sum(apply(ep_blk, p, x) ** 2)))(p_sh)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_out)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ep_a2a_impl_env_ppermute_end_to_end(devices, monkeypatch):
+    """PDTX_EP_A2A_IMPL=ppermute swaps the transport under the whole
+    block: outputs must match the native-a2a run bitwise (same floats,
+    different collective)."""
+    blk = moe_lib.MoEBlock(num_experts=4, ffn_dim=32, top_k=2,
+                           capacity_factor=1.0, dispatch_impl="dropless",
+                           ep_dispatch="a2a")
+    x = _x(seed=9, b=2, t=16)
+    params = blk.init(jax.random.PRNGKey(1), x)["params"]
+    mesh = mesh_lib.build_mesh({"expert": 4, "data": 2})
+    shardings = sharding_lib.make_shardings(params, mesh, moe_lib.EP_RULES)
+    p_sh = jax.tree.map(jax.device_put, params, shardings)
+
+    def run():
+        with mesh_lib.use_mesh(mesh):
+            out, _ = jax.jit(lambda p: blk.apply(
+                {"params": p}, x, mutable=["telemetry", "losses"]))(p_sh)
+        return np.asarray(out)
+
+    monkeypatch.setenv(moe_lib.EP_A2A_IMPL_ENV, "native")
+    a = run()
+    monkeypatch.setenv(moe_lib.EP_A2A_IMPL_ENV, "ppermute")
+    jax.clear_caches()  # env is read at trace time
+    b = run()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_ep_chunk_log_static_and_deterministic(devices, tmp_path,
+                                               monkeypatch):
+    """The a2a chunk log captures the static transfer geometry (torn last
+    chunk included) and is byte-identical across traces — the dryrun
+    gang's determinism contract."""
+    log = tmp_path / "chunks.jsonl"
+    monkeypatch.setenv(moe_lib.A2A_CHUNK_LOG_ENV, str(log))
+    blk = moe_lib.MoEBlock(num_experts=4, ffn_dim=32, top_k=2,
+                           capacity_factor=1.0, dispatch_impl="dropless",
+                           ep_dispatch="a2a_overlap", ep_overlap_chunks=3)
+    x = _x(seed=4, b=2, t=16)  # R=16 -> chunk_rows [6, 6, 4]
+    params = blk.init(jax.random.PRNGKey(0), x)["params"]
+    mesh = mesh_lib.build_mesh({"expert": 4, "data": 2})
+
+    def trace():
+        with mesh_lib.use_mesh(mesh):
+            jax.jit(lambda p: blk.apply(
+                {"params": p}, x,
+                mutable=["telemetry", "losses"])[0]).lower(params)
+
+    trace()
+    first = log.read_text()
+    trace()
+    lines = log.read_text().splitlines()
+    assert len(lines) == 2 and lines[0] == lines[1], lines
+    assert first.splitlines()[0] == lines[0]
+    import json as _json
+    row = _json.loads(lines[0])
+    assert row["mode"] == "a2a_overlap" and row["ep"] == 4
+    assert row["chunk_rows"] == [6, 6, 4] and row["rows_per_device"] == 16
+    assert row["send_bytes_per_chunk"] == [4 * w * D * 4
+                                           for w in (6, 6, 4)]
+
+
+def test_ep_overlap_hlo_interleaves_a2a_with_gmm(devices):
+    """Acceptance criterion: the a2a_overlap compiled program actually
+    interleaves per-chunk all-to-all transfers with grouped-FFN compute —
+    inspected on the optimized HLO. The plain a2a variant moves the same
+    tokens in ONE all-to-all; overlap splits it into >= n_chunks of them,
+    and at least one moe_experts_gmm computation sits strictly between
+    the first and last transfer in program order."""
+    import re as _re
+
+    x = _x(seed=2, b=2, t=16)
+    mesh = mesh_lib.build_mesh({"expert": 4, "data": 2})
+
+    def hlo(ep_dispatch, chunks=3):
+        blk = moe_lib.MoEBlock(num_experts=4, ffn_dim=32, top_k=2,
+                               capacity_factor=1.0,
+                               dispatch_impl="dropless",
+                               ep_dispatch=ep_dispatch,
+                               ep_overlap_chunks=chunks)
+        params = blk.init(jax.random.PRNGKey(0), x)["params"]
+        shardings = sharding_lib.make_shardings(params, mesh,
+                                                moe_lib.EP_RULES)
+        p_sh = jax.tree.map(jax.device_put, params, shardings)
+        with mesh_lib.use_mesh(mesh):
+            return jax.jit(lambda p: blk.apply(
+                {"params": p}, x, mutable=["telemetry", "losses"]
+            )[0]).lower(p_sh).compile().as_text()
+
+    a2a_re = _re.compile(r"= (?:\([^)]*\)|\S+) all-to-all(?:-start)?\(")
+    n_plain = len(a2a_re.findall(hlo("a2a")))
+    text = hlo("a2a_overlap", chunks=3)
+    lines = text.splitlines()
+    a2a_at = [i for i, ln in enumerate(lines) if a2a_re.search(ln)]
+    gmm_at = [i for i, ln in enumerate(lines)
+              if "moe_experts_gmm" in ln and "fusion" in ln]
+    assert n_plain >= 1 and len(a2a_at) >= 3 * n_plain, (n_plain, len(a2a_at))
+    assert gmm_at, "grouped-FFN fusions must be scope-tagged in the HLO"
+    assert any(a2a_at[0] < g < a2a_at[-1] for g in gmm_at), (
+        "no gmm compute between the first and last a2a chunk",
+        a2a_at[:4], gmm_at[:4])
