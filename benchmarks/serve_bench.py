@@ -88,18 +88,63 @@ def latency_summary(done, wall_s: float, num_chips: int) -> dict:
     }
 
 
-def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
-              telemetry=None, metrics=None) -> dict:
+def _build_engine(module, params, spec, args, *, closed_loop: bool,
+                  cached: bool, telemetry=None, metrics=None):
     from pytorch_distributed_training_example_tpu.serve import engine as engine_lib
+
+    kw = dict(decode_buckets=(1,) if closed_loop else args.decode_buckets,
+              prompt_buckets=args.prompt_buckets,
+              max_model_len=args.max_model_len, telemetry=telemetry,
+              metrics=metrics)
+    mk = lambda **extra: engine_lib.ContinuousBatchingEngine(
+        module, params, spec, **kw, **extra)
+    if args.disaggregate:
+        return engine_lib.DisaggregatedServe(
+            mk(role="prefill", prefix_cache=cached,
+               prefill_chunk=args.prefill_chunk),
+            mk(role="decode"))
+    return mk(prefix_cache=cached, prefill_chunk=args.prefill_chunk)
+
+
+def _parse_chaos(text: str | None) -> tuple[str, int] | None:
+    """``sigterm@completed=K`` / ``kill@completed=K``: drain or hard-kill
+    the second replica once K requests have completed fleet-wide."""
+    if not text:
+        return None
+    mode, _, trigger = text.partition("@")
+    if mode not in ("sigterm", "kill") or \
+            not trigger.startswith("completed="):
+        raise SystemExit(f"bad --chaos-replica {text!r} "
+                         f"(want sigterm@completed=K or kill@completed=K)")
+    return mode, int(trigger.split("=", 1)[1])
+
+
+def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
+              cached: bool = False, telemetry=None, metrics=None
+              ) -> tuple[dict, list]:
+    """One measured phase; returns (summary dict, completed Requests)."""
     from pytorch_distributed_training_example_tpu.serve import loadgen
 
-    buckets = (1,) if closed_loop else args.decode_buckets
-    eng = engine_lib.ContinuousBatchingEngine(
-        module, params, spec, decode_buckets=buckets,
-        prompt_buckets=args.prompt_buckets,
-        max_model_len=args.max_model_len, telemetry=telemetry,
-        metrics=metrics)
-    n_exec = eng.warmup()
+    submitted = len(requests)
+    replicas = 1 if closed_loop else args.replicas
+    chaos = None if closed_loop else _parse_chaos(args.chaos_replica)
+    if replicas > 1:
+        from pytorch_distributed_training_example_tpu.serve import (
+            router as router_lib)
+
+        fleet = {f"replica{i}": _build_engine(
+                     module, params, spec, args, closed_loop=closed_loop,
+                     cached=cached, telemetry=telemetry, metrics=metrics)
+                 for i in range(replicas)}
+        n_exec = sum(rep.warmup() for rep in fleet.values())
+        eng = router_lib.PrefixAffinityRouter(
+            fleet, page_size=args.page_size, policy=args.route)
+    else:
+        eng = _build_engine(module, params, spec, args,
+                            closed_loop=closed_loop, cached=cached,
+                            telemetry=telemetry, metrics=metrics)
+        n_exec = eng.warmup()
+    chaos_fired = False
     t0 = time.perf_counter()
     if closed_loop:
         for req in requests:
@@ -109,6 +154,16 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
         driver = loadgen.OpenLoopDriver(requests)
         while driver.remaining or eng.has_work:
             driver.pump(eng, time.perf_counter() - t0)
+            if chaos and not chaos_fired \
+                    and len(eng.completed) >= chaos[1]:
+                chaos_fired = True
+                target = "replica1"
+                _say(f"serve_bench: chaos {chaos[0]} -> {target} "
+                     f"(completed={len(eng.completed)})")
+                if chaos[0] == "sigterm":
+                    eng.drain(target)
+                else:
+                    eng.kill(target)
             if eng.has_work:
                 eng.step()
             else:
@@ -116,13 +171,37 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
     wall = time.perf_counter() - t0
     import jax
 
-    out = latency_summary(eng.completed, wall, jax.device_count())
-    out.update(executables=n_exec, compiles=eng.stats["compiles"],
-               decode_steps=eng.stats["decode_steps"],
-               evictions=eng.stats["evictions"])
-    assert eng.stats["compiles"] == n_exec, \
-        f"steady-state recompile: {eng.stats['compiles']} > {n_exec}"
-    return out
+    done = eng.completed
+    out = latency_summary(done, wall, jax.device_count())
+    stats = eng.stats if replicas == 1 else None
+    if stats is None:
+        stats = {}
+        for rep in fleet.values():
+            for k, v in rep.stats.items():
+                stats[k] = stats.get(k, 0) + v
+    out.update(submitted=submitted, executables=n_exec,
+               compiles=stats["compiles"], decode_steps=stats["decode_steps"],
+               evictions=stats["evictions"])
+    assert stats["compiles"] == n_exec, \
+        f"steady-state recompile: {stats['compiles']} > {n_exec}"
+    assert len(done) == submitted, \
+        f"dropped requests: completed {len(done)} of {submitted}"
+    if cached:
+        out["prefix"] = {
+            "hit_rate": round(stats["cached_tokens"]
+                              / max(stats["prompt_tokens"], 1), 4),
+            "cached_tokens": stats["cached_tokens"],
+            "prompt_tokens": stats["prompt_tokens"],
+            "cow_copies": stats["cow_copies"],
+        }
+    if args.disaggregate:
+        out["handoffs"] = stats.get("handoffs_out", 0)
+    if replicas > 1:
+        out["router"] = dict(eng.stats)
+        out["router"]["per_replica_completed"] = {
+            name: len(rep.completed) for name, rep in fleet.items()}
+        out["chaos_fired"] = chaos_fired
+    return out, done
 
 
 def aot_decode_report(model_name: str, *, batch: int, page_size: int,
@@ -178,6 +257,30 @@ def aot_decode_report(model_name: str, *, batch: int, page_size: int,
 
     compiled = jax.jit(run, donate_argnums=1).lower(
         params_abs, cache_abs, tok, pos, table, last).compile()
+    regions, ca = _tabulate_regions(compiled)
+    return {
+        "mode": "aot_hlo_model",
+        "attribution": "proportional_bytes",
+        "backend_lowering": jax.default_backend(),
+        "model": f"{model_name}_decode",
+        "per_chip_batch": batch,
+        "seq_len": max_model_len,       # KV capacity: the decode shape knob
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "precision": precision,
+        "xla_flops_per_step": ca.get("flops"),
+        "xla_bytes_accessed": ca.get("bytes accessed"),
+        "regions": regions,
+    }
+
+
+def _tabulate_regions(compiled) -> tuple[dict, dict]:
+    """Per-region modeled HBM bytes for one compiled serve program (the
+    profile_step scheme with serve_* named-scope tags)."""
+    import collections
+
+    import profile_step
+
     hlo_text = compiled.as_text()
     op_cat, _ = profile_step.build_op_categories(hlo_text)
     op_bytes = profile_step.build_op_bytes(hlo_text)
@@ -213,21 +316,78 @@ def aot_decode_report(model_name: str, *, batch: int, page_size: int,
         ca = {}
     if isinstance(ca, list):
         ca = ca[0] if ca else {}
+    return (dict(sorted(regions.items(),
+                        key=lambda kv: -kv[1]["gbytes_modeled"])), ca)
+
+
+def aot_prefill_report(model_name: str, *, prompt_bucket: int, page_size: int,
+                       num_pages: int, max_model_len: int,
+                       precision: str = "fp32") -> dict:
+    """Chipless AOT byte model of ONE batch-1 prefill program at one prompt
+    bucket — the unit of work a prefix-cache hit AVOIDS. The cached-run
+    summary converts (report gbytes / bucket) into per-token prefill cost
+    to model prefill-bytes-avoided; CI gates the census through the same
+    ``check_regression.py --aot-bytes`` golden as the decode rows (key
+    ``<model>_prefill b1 s<bucket> -``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+        pages_for_tokens)
+
+    dtype = jnp.float32 if precision == "fp32" else jnp.bfloat16
+    bundle = registry.create_model(model_name, seq_len=max_model_len,
+                                   dtype=dtype, param_dtype=dtype)
+    module = bundle.module
+    table_width = pages_for_tokens(max_model_len, page_size)
+    sds = jax.ShapeDtypeStruct
+    tok = sds((1, prompt_bucket), jnp.int32)
+    pos = sds((1, prompt_bucket), jnp.int32)
+    table = sds((1, table_width), jnp.int32)
+    last = sds((1,), jnp.int32)
+
+    def ctx(positions, page_table, last_index):
+        return dict(positions=positions, page_table=page_table,
+                    cache_spec=(num_pages, page_size),
+                    last_index=last_index, attn_impl="auto")
+
+    def init_fn(rng, tokens, positions, page_table, last_index):
+        return module.init(rng, tokens, train=False,
+                           decode_ctx=ctx(positions, page_table, last_index))
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0), tok, pos, table,
+                            last)
+    params_abs, cache_abs = shapes["params"], shapes["cache"]
+
+    def run(params, cache, tokens, positions, page_table, last_index):
+        logits, vs = module.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            decode_ctx=ctx(positions, page_table, last_index),
+            mutable=["cache"])
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), vs["cache"]
+
+    compiled = jax.jit(run, donate_argnums=1).lower(
+        params_abs, cache_abs, tok, pos, table, last).compile()
+    regions, ca = _tabulate_regions(compiled)
     return {
         "mode": "aot_hlo_model",
         "attribution": "proportional_bytes",
         "backend_lowering": jax.default_backend(),
-        "model": f"{model_name}_decode",
-        "per_chip_batch": batch,
-        "seq_len": max_model_len,       # KV capacity: the decode shape knob
+        "model": f"{model_name}_prefill",
+        "per_chip_batch": 1,
+        "seq_len": prompt_bucket,       # the prefill window: its shape knob
         "page_size": page_size,
         "num_pages": num_pages,
         "precision": precision,
         "xla_flops_per_step": ca.get("flops"),
         "xla_bytes_accessed": ca.get("bytes accessed"),
-        "regions": dict(sorted(regions.items(),
-                               key=lambda kv: -kv[1]["gbytes_modeled"])),
+        "regions": regions,
     }
+
+
+def _report_gbytes(report: dict) -> float:
+    return sum(r["gbytes_modeled"] for r in report["regions"].values())
 
 
 def _int_tuple(text: str) -> tuple[int, ...]:
@@ -252,6 +412,31 @@ def main(argv=None):
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--skip-batch1", action="store_true")
+    p.add_argument("--templates", type=int, default=0,
+                   help="shared-prefix prompt templates (Zipf-popular); "
+                        "0 = fully random prompts")
+    p.add_argument("--zipf-a", type=float, default=1.2,
+                   help="Zipf exponent for template popularity")
+    p.add_argument("--prefix-len", default="16:16",
+                   help="min:max template prefix length in tokens")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="run saturation twice (uncached baseline, then "
+                        "prefix cache ON), verify token identity, report "
+                        "hit rate + TTFT/ITL deltas + modeled "
+                        "prefill-bytes-avoided")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked-prefill window (tokens, multiple of the "
+                        "page size); 0 = whole prompt")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="prefill-role + decode-role engine pair per replica")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve replicas behind the prefix-affinity router")
+    p.add_argument("--route", default="affinity",
+                   choices=("affinity", "least_loaded"))
+    p.add_argument("--chaos-replica", default=None,
+                   help="sigterm@completed=K (drain) or kill@completed=K "
+                        "(hard loss + re-route) against replica1 during "
+                        "saturation; needs --replicas >= 2")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="start a fleetobs MetricsServer (0 = ephemeral) and "
                         "export pdtx_serve_* gauges")
@@ -263,6 +448,10 @@ def main(argv=None):
     p.add_argument("--aot-bucket", type=int, default=None,
                    help="with --aot: single-bucket report JSON on stdout "
                         "(pipe into check_regression.py --aot-bytes)")
+    p.add_argument("--aot-prefill-bucket", type=int, default=None,
+                   help="with --aot: single batch-1 PREFILL report at this "
+                        "prompt bucket on stdout (pipe into "
+                        "check_regression.py --aot-bytes)")
     p.add_argument("--json", default=None, help="also write result JSON here")
     args = p.parse_args(argv)
 
@@ -274,6 +463,15 @@ def main(argv=None):
                     "seed": args.seed}
 
     if args.aot:
+        if args.aot_prefill_bucket:
+            _say(f"serve_bench: AOT prefill model, "
+                 f"bucket {args.aot_prefill_bucket}")
+            print(json.dumps(aot_prefill_report(
+                args.model, prompt_bucket=args.aot_prefill_bucket,
+                page_size=args.page_size, num_pages=args.num_pages,
+                max_model_len=args.max_model_len,
+                precision=args.precision), indent=2))
+            return 0
         buckets = ([args.aot_bucket] if args.aot_bucket
                    else list(args.decode_buckets))
         reports = []
@@ -286,6 +484,12 @@ def main(argv=None):
         if args.aot_bucket:
             print(json.dumps(reports[0], indent=2))
             return 0
+        for sp in args.prompt_buckets:
+            _say(f"serve_bench: AOT prefill model, bucket {sp}")
+            reports.append(aot_prefill_report(
+                args.model, prompt_bucket=sp, page_size=args.page_size,
+                num_pages=args.num_pages, max_model_len=args.max_model_len,
+                precision=args.precision))
         result["aot"] = reports
         print(json.dumps(result, indent=2))
         if args.json:
@@ -298,15 +502,22 @@ def main(argv=None):
 
     pl_min, pl_max = (int(t) for t in args.prompt_len.split(":"))
     mn_min, mn_max = (int(t) for t in args.max_new.split(":"))
+    pfx_min, pfx_max = (int(t) for t in args.prefix_len.split(":"))
     module, params, spec = build_serving(
         args.model, page_size=args.page_size, num_pages=args.num_pages,
         max_model_len=args.max_model_len, precision=args.precision,
         seed=args.seed)
     vocab = int(module.vocab_size)
+    if args.templates and pl_max + pfx_max > max(args.prompt_buckets):
+        raise SystemExit(
+            f"--templates: prefix {pfx_max} + prompt {pl_max} exceeds the "
+            f"largest prompt bucket {max(args.prompt_buckets)}")
     mkload = lambda rate, n, seed: loadgen.generate_requests(loadgen.LoadSpec(
         num_requests=n, rate=rate, prompt_len_min=pl_min,
         prompt_len_max=pl_max, max_new_min=mn_min, max_new_max=mn_max,
-        vocab_size=vocab, eos_id=args.eos_id, seed=seed))
+        vocab_size=vocab, eos_id=args.eos_id, seed=seed,
+        num_templates=args.templates, zipf_a=args.zipf_a,
+        prefix_len_min=pfx_min, prefix_len_max=pfx_max))
 
     metrics = None
     if args.metrics_port is not None:
@@ -319,13 +530,13 @@ def main(argv=None):
 
     if not args.skip_batch1:
         _say("serve_bench: phase batch1 (closed loop)")
-        result["batch1"] = run_phase(
+        result["batch1"], _ = run_phase(
             module, params, spec, args, mkload(0.0, min(args.requests, 8),
                                                args.seed + 1),
             closed_loop=True, telemetry=recorder, metrics=metrics)
         _say(f"  batch1: {result['batch1']['tokens_per_s_per_chip']} tok/s/chip")
     _say(f"serve_bench: phase saturation (open loop, rate={args.rate})")
-    result["saturation"] = run_phase(
+    result["saturation"], base_done = run_phase(
         module, params, spec, args, mkload(args.rate, args.requests,
                                            args.seed),
         closed_loop=False, telemetry=recorder, metrics=metrics)
@@ -334,6 +545,43 @@ def main(argv=None):
          f"ttft p50/p99 {sat['ttft_ms']['p50']}/{sat['ttft_ms']['p99']} ms, "
          f"itl p50/p99 {sat['inter_token_ms']['p50']}"
          f"/{sat['inter_token_ms']['p99']} ms")
+    if args.prefix_cache:
+        _say("serve_bench: phase saturation_cached (prefix cache ON, "
+             "same seeded stream)")
+        result["saturation_cached"], cached_done = run_phase(
+            module, params, spec, args, mkload(args.rate, args.requests,
+                                               args.seed),
+            closed_loop=False, cached=True, telemetry=recorder,
+            metrics=metrics)
+        csat = result["saturation_cached"]
+        base_by_id = {r.request_id: r.generated for r in base_done}
+        for r in cached_done:
+            assert r.generated == base_by_id[r.request_id], \
+                f"token identity broken for {r.request_id}"
+        prefill_report = aot_prefill_report(
+            args.model, prompt_bucket=max(args.prompt_buckets),
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_model_len=args.max_model_len, precision=args.precision)
+        per_tok_gb = _report_gbytes(prefill_report) / max(args.prompt_buckets)
+        delta = lambda k, q: (None if sat[k][q] is None or csat[k][q] is None
+                              else round(csat[k][q] - sat[k][q], 3))
+        result["prefix_cache"] = {
+            **csat["prefix"],
+            "token_identity": "ok",
+            "ttft_ms_delta": {"p50": delta("ttft_ms", "p50"),
+                              "p99": delta("ttft_ms", "p99")},
+            "inter_token_ms_delta": {
+                "p50": delta("inter_token_ms", "p50"),
+                "p99": delta("inter_token_ms", "p99")},
+            "prefill_gbytes_avoided_modeled": round(
+                per_tok_gb * csat["prefix"]["cached_tokens"], 4),
+            "prefill_bucket_gbytes_modeled": round(
+                _report_gbytes(prefill_report), 4),
+        }
+        _say(f"  prefix cache: hit {result['prefix_cache']['hit_rate']}, "
+             f"ttft p50 delta {result['prefix_cache']['ttft_ms_delta']['p50']}"
+             f" ms, modeled prefill GB avoided "
+             f"{result['prefix_cache']['prefill_gbytes_avoided_modeled']}")
     result["goodput"] = {k: recorder.goodput()[k]
                          for k in ("goodput_fraction", "coverage", "wall_s",
                                    "categories_s")}

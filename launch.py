@@ -448,6 +448,7 @@ def run_fleet(args) -> int:
         port = coordinator_port(None)
         env = os.environ.copy()
         env.update(dict(st.spec.env))
+        env["PDTX_JOB_KIND"] = st.spec.kind
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["NUM_PROCESSES"], env["PROCESS_ID"] = "1", "0"
         env["MASTER_ADDR"], env["MASTER_PORT"] = "127.0.0.1", str(port)

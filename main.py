@@ -233,6 +233,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on SIGTERM, seconds to let in-flight sequences "
                         "finish decoding before exiting 75 (graceful "
                         "preemption of a serving session)")
+    p.add_argument("--serve-prefix-cache", action="store_true", default=None,
+                   dest="serve_prefix_cache",
+                   help="share prompt-prefix KV pages across requests "
+                        "(copy-on-write; serve/prefix_cache.py)")
+    p.add_argument("--serve-prefill-chunk", type=int, default=None,
+                   dest="serve_prefill_chunk",
+                   help="chunked prefill window in tokens (multiple of the "
+                        "page size); 0 = whole prompt in one program")
+    p.add_argument("--serve-disaggregate", action="store_true", default=None,
+                   dest="serve_disaggregate",
+                   help="split serving into a prefill-role and a decode-role "
+                        "engine with explicit KV-page handoff")
+    p.add_argument("--serve-replicas", type=int, default=None,
+                   dest="serve_replicas",
+                   help="serve replicas behind the prefix-affinity router "
+                        "(serve/router.py); 1 = no router")
+    p.add_argument("--serve-route", default=None, dest="serve_route",
+                   choices=["affinity", "least_loaded"],
+                   help="replica placement policy")
+    p.add_argument("--serve-templates", type=int, default=None,
+                   dest="serve_templates",
+                   help="shared-prefix prompt templates in the synthetic "
+                        "stream (0 = fully random prompts)")
+    p.add_argument("--serve-zipf-a", type=float, default=None,
+                   dest="serve_zipf_a",
+                   help="Zipf exponent for template popularity")
+    p.add_argument("--serve-prefix-len", default=None, dest="serve_prefix_len",
+                   help="template length range, \"min:max\" tokens")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,

@@ -104,9 +104,14 @@ class LlamaAttention(nn.Module):
         flax ``cache`` collection — the engine threads it through each step
         via ``mutable=["cache"]`` and donates the buffers), then attention
         reads the cache. S == 1 is a decode step (paged flash-decode
-        kernel); S > 1 is prefill of fresh prompts starting at position 0,
+        kernel); S > 1 is prefill. A fresh prefill starts at position 0,
         where causal self-attention over the chunk IS the full answer, so
-        it reuses the training dispatcher for exact parity."""
+        it reuses the training dispatcher for exact parity. A window with
+        HISTORY (suffix prefill after a prefix-cache splice, or a later
+        chunk of a chunked prefill — ``decode_ctx["history"]``, static so
+        each flavor is its own compiled program) must also attend to the
+        cached positions before it, so it reads back through the page
+        table instead."""
         from pytorch_distributed_training_example_tpu.ops import (
             flash_attention as flash_lib)
         from pytorch_distributed_training_example_tpu.serve import kv_cache
@@ -133,6 +138,9 @@ class LlamaAttention(nn.Module):
                     q[:, 0], k_pages.value, v_pages.value, page_table,
                     positions[:, 0],
                     impl=decode_ctx.get("attn_impl", "auto"))[:, None]
+            elif decode_ctx.get("history"):
+                out = flash_lib.paged_prefill_attention(
+                    q, k_pages.value, v_pages.value, page_table, positions)
             else:
                 out = attn_lib.attention(q, k, v, causal=True,
                                          impl=self.attn_impl)

@@ -1187,3 +1187,45 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, positions,
                                     positions, sm_scale)
     return _paged_decode_xla(q, k_pages, v_pages, page_table, positions,
                              sm_scale)
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, positions):
+    """Prefill-window attention against a paged KV cache with history.
+
+    q:          [B, S, H, D] — a window of query tokens starting mid-
+                sequence (suffix prefill after a prefix-cache splice, or
+                a later chunk of a chunked prefill)
+    k_pages:    [num_pages, page_size, Hkv, D] pool (one layer's K)
+    v_pages:    same shape, the layer's V
+    page_table: [B, max_pages] int32 physical page ids
+    positions:  [B, S] int32 logical position of each query token; keys
+                at pool positions <= positions[b, s] are attended, which
+                is causal masking that also covers the history before
+                the window (those keys came from cached/earlier pages —
+                the window's own K/V are appended before this runs).
+
+    Plain-causal attention is wrong here: it would start every window at
+    position 0. This is the gather-based XLA path (fp32 softmax, GQA
+    grouped like ``_paged_decode_xla``); decode-bound serving keeps the
+    Pallas budget on the decode kernel.
+    """
+    B, S, H, D = q.shape
+    _, page_size, num_kv_heads, _ = k_pages.shape
+    if H % num_kv_heads:
+        raise ValueError(f"H={H} not a multiple of Hkv={num_kv_heads}")
+    sm_scale = 1.0 / math.sqrt(D)
+    page_table = page_table.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    T = page_table.shape[1] * page_size
+    flat = page_table.reshape(-1)
+    k = jnp.take(k_pages, flat, axis=0).reshape(B, T, num_kv_heads, D)
+    v = jnp.take(v_pages, flat, axis=0).reshape(B, T, num_kv_heads, D)
+    G = H // num_kv_heads
+    qg = q.reshape(B, S, num_kv_heads, G, D)
+    logits = jnp.einsum("bshgd,bthd->bhgst", _mxu(qg), _mxu(k),
+                        preferred_element_type=jnp.float32) * sm_scale
+    mask = jnp.arange(T)[None, None, :] <= positions[:, :, None]  # [B, S, T]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    prob = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", prob, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)

@@ -22,6 +22,24 @@ Eviction: a slot that cannot get a page (pool exhausted) evicts the
 YOUNGEST active request — its pages free immediately and the request
 re-queues at the head of the waiting line, to be recomputed when pressure
 drops (recompute-on-readmit, the classic vLLM preemption policy).
+
+r17 grows three serving-throughput layers on the same skeleton:
+
+- PREFIX CACHING (``prefix_cache=True``): ``_admit`` consults a
+  ``PrefixCache`` tree and splices matched pages into the request's page
+  table instead of prefilling them; only the un-cached suffix runs
+  through a (history-flavored) prefill program. Writes that would land
+  in a page with pool refcount > 1 copy-on-write through one compiled
+  ``copy_page`` program.
+- CHUNKED PREFILL (``prefill_chunk=N``): prefill runs as a sequence of
+  at-most-N-token windows. Under ``role="prefill"`` each slot advances
+  ONE window per step, so a long prompt never monopolizes an iteration.
+- DISAGGREGATION (``role="prefill"`` / ``role="decode"``): a prefill-only
+  engine hands finished prompts to a decode-only engine as ``Handoff``
+  blocks — the KV pages extracted through one fixed-width compiled
+  program and inserted into the decode pool through another, so the
+  decode batch never shares a step with a prefill. ``DisaggregatedServe``
+  drives such a pair behind the single-engine interface.
 """
 
 from __future__ import annotations
@@ -39,6 +57,8 @@ import numpy as np
 from pytorch_distributed_training_example_tpu.serve import kv_cache
 from pytorch_distributed_training_example_tpu.serve.kv_cache import (
     CacheSpec, PagePool, pages_for_tokens)
+from pytorch_distributed_training_example_tpu.serve.prefix_cache import (
+    PrefixCache)
 
 
 @dataclasses.dataclass
@@ -75,6 +95,19 @@ class Request:
         return len(self.prompt) + len(self.generated) >= max_len
 
 
+@dataclasses.dataclass
+class Handoff:
+    """A prefilled request crossing the prefill→decode boundary: its KV
+    pages (extracted as a fixed-width device block), how many of the
+    block's rows are real, and the decode resume state."""
+
+    req: Request
+    block: Any              # pytree of [W, page_size, Hkv, D] per layer/KV
+    n_pages: int
+    length: int             # prompt length == next append position
+    next_token: int         # the prefill's argmax, decode's first input
+
+
 def spec_for_module(module, *, num_pages: int, page_size: int) -> CacheSpec:
     """Cache geometry from a decode-capable model's own attributes, so the
     pools always match the flax ``cache`` variables the model declares."""
@@ -96,8 +129,11 @@ class ContinuousBatchingEngine:
     ``module`` is the flax model (decode-capable: ``decode_ctx`` kwarg),
     ``params`` its restored parameters. ``telemetry`` (a
     ``SpanRecorder``) and ``metrics`` (a fleetobs ``MetricsServer``) are
-    optional; when present the engine records prefill/step goodput spans
-    and exports ``pdtx_serve_*`` gauges.
+    optional; when present the engine records per-role goodput spans
+    (``prefill`` / ``step`` / ``decode``) and exports ``pdtx_serve_*``
+    gauges. ``role`` is ``"both"`` (the r15 single-engine path),
+    ``"prefill"`` (admit + prefill only, finished prompts queue in
+    ``handoffs``) or ``"decode"`` (drains ``ingest``-ed handoffs only).
     """
 
     def __init__(self, module, params, spec: CacheSpec, *,
@@ -105,11 +141,17 @@ class ContinuousBatchingEngine:
                  prompt_buckets: tuple[int, ...] = (16, 32, 64),
                  max_model_len: int | None = None,
                  attn_impl: str = "auto",
+                 prefix_cache: bool = False,
+                 prefill_chunk: int = 0,
+                 role: str = "both",
                  telemetry=None, metrics=None,
                  clock: Callable[[], float] = time.perf_counter):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
         self.module = module
         self.params = params
         self.spec = spec
+        self.role = role
         self.decode_buckets = tuple(sorted(decode_buckets))
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         model_cap = getattr(module, "max_seq_len", None) or spec.max_len
@@ -119,7 +161,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
                 f"max_model_len {self.max_model_len}")
+        if prefill_chunk and prefill_chunk % spec.page_size:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be a multiple of "
+                f"page_size={spec.page_size} (windows must not split a "
+                f"page between programs)")
         self.attn_impl = attn_impl
+        self.prefill_chunk = int(prefill_chunk)
         self.telemetry = telemetry
         self.metrics = metrics
         self._clock = clock
@@ -127,23 +175,35 @@ class ContinuousBatchingEngine:
                                             spec.page_size)
 
         self.pool = PagePool(spec.num_pages)
+        self.prefix_cache = (PrefixCache(self.pool, spec.page_size)
+                             if prefix_cache else None)
         self.cache = kv_cache.init_cache(spec)
         self.waiting: collections.deque[Request] = collections.deque()
         max_b = self.decode_buckets[-1]
         self.slots: list[Request | None] = [None] * max_b
-        # Host mirrors of per-slot device state.
+        # Host mirrors of per-slot device state. ``_pages`` is the
+        # engine's own ordered page list per slot — COW swaps individual
+        # entries, so ``pool.owned`` order can no longer be trusted.
         self._tables = np.zeros((max_b, self.table_width), np.int32)
         self._lens = np.zeros(max_b, np.int32)
         self._next_tok = np.zeros(max_b, np.int32)
+        self._pages: list[list[int]] = [[] for _ in range(max_b)]
+        self._nodes: dict[str, list] = {}      # rid -> pinned cache nodes
+        self._prefill_pos: dict[int, int] = {}  # slot -> next window start
+        self._inbox: collections.deque[Handoff] = collections.deque()
+        self.handoffs: list[Handoff] = []
+        self.requeued: list[Request] = []
         self.completed: list[Request] = []
         self.stats = {"compiles": 0, "prefills": 0, "decode_steps": 0,
-                      "tokens_generated": 0, "evictions": 0, "admitted": 0}
+                      "tokens_generated": 0, "evictions": 0, "admitted": 0,
+                      "prompt_tokens": 0, "cached_tokens": 0,
+                      "cow_copies": 0, "handoffs_out": 0, "handoffs_in": 0}
         self._compiled: dict[tuple, Any] = {}
         self._t0 = self._clock()
 
     # ---------------------------------------------------------------- steps
 
-    def _decode_fn(self):
+    def _decode_fn(self, history: bool = False):
         spec = self.spec
 
         def run(params, cache, tokens, positions, page_table, last_index):
@@ -151,7 +211,7 @@ class ContinuousBatchingEngine:
                 {"params": params, "cache": cache}, tokens, train=False,
                 decode_ctx=dict(positions=positions, page_table=page_table,
                                 cache_spec=(spec.num_pages, spec.page_size),
-                                last_index=last_index,
+                                last_index=last_index, history=history,
                                 attn_impl=self.attn_impl),
                 mutable=["cache"])
             return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
@@ -170,7 +230,8 @@ class ContinuousBatchingEngine:
         source of truth the no-recompile test asserts on."""
         key = (kind, batch, seq)
         if key not in self._compiled:
-            fn = jax.jit(self._decode_fn(), donate_argnums=1)
+            fn = jax.jit(self._decode_fn(history=(kind == "prefill_hist")),
+                         donate_argnums=1)
             args = (
                 self._abstract(self.params), self._abstract(self.cache),
                 jax.ShapeDtypeStruct((batch, seq), jnp.int32),
@@ -182,14 +243,54 @@ class ContinuousBatchingEngine:
             self.stats["compiles"] += 1
         return self._compiled[key]
 
+    def _get_aux(self, kind: str):
+        """The non-forward compiled programs: ``cow`` (clone one page),
+        ``export``/``import`` (fixed-width handoff block out of / into
+        this pool). One shape each, compiled once, counted in
+        ``stats["compiles"]`` like every other program."""
+        key = (kind, 0, 0)
+        if key not in self._compiled:
+            cache_abs = self._abstract(self.cache)
+            ids_abs = jax.ShapeDtypeStruct((self.table_width,), jnp.int32)
+            if kind == "cow":
+                fn = jax.jit(kv_cache.copy_page, donate_argnums=0)
+                scalar = jax.ShapeDtypeStruct((), jnp.int32)
+                lowered = fn.lower(cache_abs, scalar, scalar)
+            elif kind == "export":
+                fn = jax.jit(kv_cache.extract_pages)
+                lowered = fn.lower(cache_abs, ids_abs)
+            elif kind == "import":
+                fn = jax.jit(kv_cache.insert_pages, donate_argnums=0)
+                block_abs = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        (self.table_width,) + s.shape[1:], s.dtype),
+                    cache_abs)
+                lowered = fn.lower(cache_abs, block_abs, ids_abs)
+            else:
+                raise ValueError(f"unknown aux program {kind!r}")
+            self._compiled[key] = lowered.compile()
+            self.stats["compiles"] += 1
+        return self._compiled[key]
+
     def warmup(self) -> int:
-        """Precompile every decode bucket and every batch-1 prefill bucket;
-        returns the number of executables. After this, steady-state
-        continuous batching runs entirely out of ``_compiled``."""
-        for b in self.decode_buckets:
-            self._get_step("decode", b, 1)
-        for sp in self.prompt_buckets:
-            self._get_step("prefill", 1, sp)
+        """Precompile every program this role can reach; returns the
+        executable count. After this, steady-state serving runs entirely
+        out of ``_compiled`` — ``stats["compiles"]`` must stay flat."""
+        if self.role in ("both", "decode"):
+            for b in self.decode_buckets:
+                self._get_step("decode", b, 1)
+        if self.role in ("both", "prefill"):
+            for sp in self.prompt_buckets:
+                self._get_step("prefill", 1, sp)
+            if self.prefix_cache is not None or self.prefill_chunk:
+                for sp in self.prompt_buckets:
+                    self._get_step("prefill_hist", 1, sp)
+        if self.prefix_cache is not None:
+            self._get_aux("cow")
+        if self.role == "prefill":
+            self._get_aux("export")
+        if self.role == "decode":
+            self._get_aux("import")
         return len(self._compiled)
 
     # ------------------------------------------------------------ scheduling
@@ -200,9 +301,12 @@ class ContinuousBatchingEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting) or self.num_active > 0
+        return bool(self.waiting) or bool(self._inbox) or self.num_active > 0
 
     def submit(self, req: Request) -> None:
+        if self.role == "decode":
+            raise ValueError("decode-role engine takes Handoffs via "
+                             "ingest(), not fresh requests")
         if len(req.prompt) > self.prompt_buckets[-1]:
             raise ValueError(
                 f"prompt of {len(req.prompt)} tokens exceeds largest "
@@ -210,49 +314,163 @@ class ContinuousBatchingEngine:
         req.submit_t = self._clock()
         self.waiting.append(req)
 
+    def ingest(self, handoff: Handoff) -> None:
+        """Decode role: queue a prefilled request for placement at the
+        next step (placement needs a slot and pages, so it happens in
+        step order like any other admission)."""
+        if self.role != "decode":
+            raise ValueError("only decode-role engines ingest handoffs")
+        self._inbox.append(handoff)
+
+    def take_handoffs(self) -> list[Handoff]:
+        out, self.handoffs = self.handoffs, []
+        return out
+
+    def take_requeued(self) -> list[Request]:
+        out, self.requeued = self.requeued, []
+        return out
+
     def _free_slot(self) -> int | None:
         for i, r in enumerate(self.slots):
             if r is None:
                 return i
         return None
 
+    def _reserve(self, n: int) -> bool:
+        """Can ``n`` pages be allocated, evicting unreferenced prefix-cache
+        pages (LRU) first if the free list is short?"""
+        if self.pool.can_alloc(n):
+            return True
+        if self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.pool.num_free)
+        return self.pool.can_alloc(n)
+
     def _admit(self) -> list[int]:
-        """Move waiting requests into free slots while pages last; prefill
-        each (batch-1, prompt-bucket shape). Returns admitted slot ids."""
+        """Move waiting requests into free slots while pages last. A
+        prefix-cache hit splices the matched pages into the page table
+        and only the suffix is prefilled; the page containing the first
+        prefilled position is copy-on-written up front if it is shared.
+        Role "both" prefills to completion inline (r15 semantics); role
+        "prefill" queues windows that ``step`` advances one at a time."""
         admitted = []
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
                 break
             req = self.waiting[0]
-            need = pages_for_tokens(len(req.prompt) + 1, self.spec.page_size)
-            if not self.pool.can_alloc(need):
+            plen = len(req.prompt)
+            ps = self.spec.page_size
+            match = None
+            shared: list[int] = []
+            if self.prefix_cache is not None:
+                match = self.prefix_cache.match(req.prompt,
+                                                max_tokens=plen - 1)
+                shared = match.pages
+            start = match.tokens if match else 0
+            cow_idx = start // ps if shared and start // ps < len(shared) \
+                else None
+            need_new = pages_for_tokens(plen + 1, ps) - len(shared)
+            if shared:
+                # Pin BEFORE reserving: _reserve may LRU-evict exactly the
+                # unreferenced cache pages this match is about to splice.
+                self.prefix_cache.acquire(match, req.request_id)
+            if not self._reserve(need_new + (1 if cow_idx is not None else 0)):
+                if shared:
+                    self.prefix_cache.release(match.nodes)
+                    self.pool.free(req.request_id)
                 break
             self.waiting.popleft()
-            pages = self.pool.alloc(req.request_id, need)
+            if shared:
+                self._nodes[req.request_id] = list(match.nodes)
+                self.stats["cached_tokens"] += start
+            pages = shared + (self.pool.alloc(req.request_id, need_new)
+                              if need_new else [])
             self.slots[slot] = req
+            self._pages[slot] = pages
             self._tables[slot] = 0
-            self._tables[slot, :need] = pages
-            self._lens[slot] = len(req.prompt)
+            self._tables[slot, :len(pages)] = pages
+            self._lens[slot] = plen
             self.stats["admitted"] += 1
-            self._prefill(slot, req)
+            self.stats["prompt_tokens"] += plen
+            if cow_idx is not None:
+                self._cow(slot, cow_idx)
+            if self.role == "prefill":
+                self._prefill_pos[slot] = start
+            else:
+                self._prefill(slot, req, start)
             admitted.append(slot)
         return admitted
 
-    def _prefill(self, slot: int, req: Request) -> None:
+    def _cow(self, slot: int, idx: int) -> None:
+        """Copy-on-write page ``idx`` of ``slot``: clone it into a fresh
+        private page, swap the table entry, release this request's share
+        of the old page (and its cache pin, if that is where the share
+        came from). Callers reserve the page beforehand."""
+        req = self.slots[slot]
+        old = self._pages[slot][idx]
+        (new,) = self.pool.alloc(req.request_id, 1)
+        step = self._get_aux("cow")
+        self.cache = step(self.cache, jnp.asarray(old, jnp.int32),
+                          jnp.asarray(new, jnp.int32))
+        self._pages[slot][idx] = new
+        self._tables[slot, idx] = new
+        self.pool.drop(req.request_id, old)
+        nodes = self._nodes.get(req.request_id)
+        if nodes is not None:
+            for node in nodes:
+                if node.page == old:
+                    self.prefix_cache.release([node])
+                    nodes.remove(node)
+                    break
+        self.stats["cow_copies"] += 1
+
+    def _window_cap(self) -> int:
+        return self.prefill_chunk or self.prompt_buckets[-1]
+
+    def _prefill(self, slot: int, req: Request, start: int = 0) -> None:
+        """Prefill ``req`` from position ``start`` (cached tokens before it
+        are already in spliced pages) to completion, one window per
+        compiled program, then finish (first token + retire/handoff)."""
         plen = len(req.prompt)
-        sp = _bucket(plen, self.prompt_buckets)
-        step = self._get_step("prefill", 1, sp)
+        pos = start
+        first = 0
+        while pos < plen:
+            n = min(plen - pos, self._window_cap())
+            first = self._prefill_window(slot, req, pos, n)
+            pos += n
+        self._finish_prefill(slot, req, first)
+
+    def _prefill_window(self, slot: int, req: Request, pos: int,
+                        n: int) -> int:
+        """One prefill window: tokens [pos, pos+n) at their true
+        positions. ``pos == 0`` is the plain causal program; ``pos > 0``
+        runs the history flavor, which reads the earlier positions back
+        through the page table. Returns the argmax after the window's
+        last token (only the final window's matters)."""
+        sp = _bucket(n, self.prompt_buckets)
+        kind = "prefill_hist" if pos > 0 else "prefill"
+        step = self._get_step(kind, 1, sp)
         tokens = np.zeros((1, sp), np.int32)
-        tokens[0, :plen] = req.prompt
-        positions = np.arange(sp, dtype=np.int32)[None]
+        tokens[0, :n] = req.prompt[pos:pos + n]
+        # Padded tail positions are clipped into table range; they write
+        # garbage into not-yet-used (or scratch) slots that later real
+        # appends overwrite and position masking hides meanwhile.
+        positions = np.minimum(pos + np.arange(sp, dtype=np.int32),
+                               self.table_width * self.spec.page_size - 1)
         table = self._tables[slot:slot + 1]
-        last = np.asarray([plen - 1], np.int32)
+        last = np.asarray([n - 1], np.int32)
         with self._span("prefill"):
             tok, self.cache = step(self.params, self.cache,
-                                   jnp.asarray(tokens), jnp.asarray(positions),
+                                   jnp.asarray(tokens),
+                                   jnp.asarray(positions[None]),
                                    jnp.asarray(table), jnp.asarray(last))
             first = int(np.asarray(tok)[0])
+        return first
+
+    def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
+        """Prefill done: record the first token, publish the prompt's
+        pages to the prefix cache, then either retire (role "both", or
+        already finished) or queue the KV handoff (role "prefill")."""
         now = self._clock()
         req.generated.append(first)
         req.first_token_t = now
@@ -260,36 +478,104 @@ class ContinuousBatchingEngine:
         self._next_tok[slot] = first
         self.stats["prefills"] += 1
         self.stats["tokens_generated"] += 1
-        self._retire(slot)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, self._pages[slot])
+        if self.role == "prefill" and not req.finished(self.max_model_len):
+            self._handoff(slot, req, first)
+        else:
+            self._retire(slot)
+
+    def _handoff(self, slot: int, req: Request, first: int) -> None:
+        """Extract the slot's pages as a fixed-width block and queue it
+        for the decode engine; this engine's copies release immediately
+        (the prefix cache keeps its own pins on published pages)."""
+        pages = self._pages[slot]
+        ids = np.zeros(self.table_width, np.int32)
+        ids[:len(pages)] = pages
+        step = self._get_aux("export")
+        block = step(self.cache, jnp.asarray(ids))
+        self.handoffs.append(Handoff(req=req, block=block,
+                                     n_pages=len(pages),
+                                     length=len(req.prompt),
+                                     next_token=first))
+        self.stats["handoffs_out"] += 1
+        self._release_slot(slot)
+
+    def _place(self, handoff: Handoff, slot: int) -> None:
+        """Decode role: import a handoff block into freshly-allocated
+        pages and resume the request mid-sequence."""
+        req = handoff.req
+        pages = self.pool.alloc(req.request_id, handoff.n_pages)
+        ids = np.zeros(self.table_width, np.int32)
+        ids[:len(pages)] = pages
+        step = self._get_aux("import")
+        self.cache = step(self.cache, handoff.block, jnp.asarray(ids))
+        self.slots[slot] = req
+        self._pages[slot] = pages
+        self._tables[slot] = 0
+        self._tables[slot, :len(pages)] = pages
+        self._lens[slot] = handoff.length
+        self._next_tok[slot] = handoff.next_token
+        self.stats["handoffs_in"] += 1
+        self.stats["admitted"] += 1
+
+    def _drain_inbox(self) -> None:
+        while self._inbox:
+            slot = self._free_slot()
+            if slot is None or not self._reserve(self._inbox[0].n_pages):
+                break
+            self._place(self._inbox.popleft(), slot)
 
     def _ensure_pages(self) -> None:
-        """Every active slot must own the page its NEXT append lands in;
-        allocate incrementally, evicting the youngest request on OOM."""
+        """Every active slot must be able to take its NEXT append: the
+        target page must exist (allocate incrementally) and be private
+        (copy-on-write if its pool refcount exceeds one — someone else,
+        possibly the prefix cache, still reads the original bytes).
+        Evicts the youngest request on OOM."""
         while True:
-            need_slot = None
+            pending = None
             for i, req in enumerate(self.slots):
                 if req is None:
                     continue
-                pos = int(self._lens[i])  # next token's position
-                page_idx = pos // self.spec.page_size
-                owned = len(self.pool.owned(req.request_id))
-                if page_idx >= owned:
-                    need_slot = i
+                idx = int(self._lens[i]) // self.spec.page_size
+                if idx >= len(self._pages[i]):
+                    pending = (i, "grow", idx)
                     break
-            if need_slot is None:
+                if self.pool.refcount(self._pages[i][idx]) > 1:
+                    pending = (i, "cow", idx)
+                    break
+            if pending is None:
                 return
-            req = self.slots[need_slot]
-            if self.pool.can_alloc(1):
-                (page,) = self.pool.alloc(req.request_id, 1)
-                owned = len(self.pool.owned(req.request_id))
-                self._tables[need_slot, owned - 1] = page
+            i, what, idx = pending
+            if self._reserve(1):
+                if what == "grow":
+                    req = self.slots[i]
+                    (page,) = self.pool.alloc(req.request_id, 1)
+                    self._pages[i].append(page)
+                    self._tables[i, len(self._pages[i]) - 1] = page
+                else:
+                    self._cow(i, idx)
                 continue
             self._evict()
 
+    def _release_slot(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.pool.free(req.request_id)
+        nodes = self._nodes.pop(req.request_id, None)
+        if nodes and self.prefix_cache is not None:
+            self.prefix_cache.release(nodes)
+        self.slots[slot] = None
+        self._lens[slot] = 0
+        self._tables[slot] = 0
+        self._pages[slot] = []
+        self._prefill_pos.pop(slot, None)
+
     def _evict(self) -> None:
         """Free the youngest active request and requeue it (recompute on
-        readmission). Raises if nothing is evictable — the pool is too
-        small for even one request, a configuration error."""
+        readmission). A decode-role engine cannot re-prefill, so its
+        victims land in ``requeued`` for the pair driver to send back to
+        the prefill engine. Raises if nothing is evictable — the pool is
+        too small for even one request, a configuration error."""
         youngest, slot = None, None
         for i, req in enumerate(self.slots):
             if req is None:
@@ -299,24 +585,21 @@ class ContinuousBatchingEngine:
         if youngest is None:
             raise MemoryError("page pool exhausted with no active request "
                               "to evict — num_pages is too small")
-        self.pool.free(youngest.request_id)
-        self.slots[slot] = None
-        self._lens[slot] = 0
-        self._tables[slot] = 0
+        self._release_slot(slot)
         youngest.generated.clear()
         youngest.token_times.clear()
         youngest.first_token_t = None
         youngest.evictions += 1
         self.stats["evictions"] += 1
-        self.waiting.appendleft(youngest)
+        if self.role == "decode":
+            self.requeued.append(youngest)
+        else:
+            self.waiting.appendleft(youngest)
 
     def _retire(self, slot: int) -> None:
         req = self.slots[slot]
         if req is not None and req.finished(self.max_model_len):
-            self.pool.free(req.request_id)
-            self.slots[slot] = None
-            self._lens[slot] = 0
-            self._tables[slot] = 0
+            self._release_slot(slot)
             self.completed.append(req)
 
     def _span(self, name: str):
@@ -326,14 +609,44 @@ class ContinuousBatchingEngine:
 
     # ---------------------------------------------------------------- step
 
+    def _advance_prefills(self) -> int:
+        """Prefill role: one window per in-flight slot per step, so long
+        prompts interleave instead of monopolizing. Returns first tokens
+        produced (prefills that completed this step)."""
+        produced = 0
+        for slot, req in enumerate(self.slots):
+            if req is None or slot not in self._prefill_pos:
+                continue
+            pos = self._prefill_pos[slot]
+            plen = len(req.prompt)
+            n = min(plen - pos, self._window_cap())
+            first = self._prefill_window(slot, req, pos, n)
+            pos += n
+            if pos >= plen:
+                del self._prefill_pos[slot]
+                self._finish_prefill(slot, req, first)
+                produced += 1
+            else:
+                self._prefill_pos[slot] = pos
+        return produced
+
     def step(self, admit: bool = True) -> int:
-        """One scheduling iteration: admit+prefill, then one decode step
-        over the active slots (padded to a batch bucket). Returns tokens
-        generated this iteration. ``admit=False`` is the drain mode a
-        graceful shutdown uses: in-flight sequences keep decoding to
-        completion but nothing moves from the waiting queue into a slot."""
-        if admit:
+        """One scheduling iteration. Role "both": admit+prefill, then one
+        decode step over the active slots (padded to a batch bucket).
+        Role "prefill": admit, then advance each in-flight prefill one
+        window. Role "decode": place queued handoffs, then decode.
+        Returns tokens generated this iteration. ``admit=False`` is the
+        drain mode a graceful shutdown uses: in-flight sequences keep
+        decoding to completion but nothing new enters a slot."""
+        if self.role == "decode":
+            if admit:
+                self._drain_inbox()
+        elif admit:
             self._admit()
+        if self.role == "prefill":
+            produced = self._advance_prefills()
+            self._export_metrics()
+            return produced
         active = [i for i, r in enumerate(self.slots) if r is not None]
         produced = 0
         if active:
@@ -354,7 +667,7 @@ class ContinuousBatchingEngine:
                     positions[j] = 0
                     table[j] = 0
             step = self._get_step("decode", bucket, 1)
-            with self._span("step"):
+            with self._span("decode" if self.role == "decode" else "step"):
                 tok, self.cache = step(
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(positions), jnp.asarray(table),
@@ -387,11 +700,25 @@ class ContinuousBatchingEngine:
                                    "steps (stop conditions broken?)")
         return self.completed
 
+    def prefix_hit_rate(self) -> float:
+        return self.stats["cached_tokens"] / max(self.stats["prompt_tokens"],
+                                                 1)
+
     def _export_metrics(self) -> None:
         if self.metrics is None:
             return
         elapsed = max(self._clock() - self._t0, 1e-9)
+        extra = {}
+        if self.prefix_cache is not None:
+            extra = dict(
+                serve_prefix_hit_rate=self.prefix_hit_rate(),
+                serve_cached_pages=self.prefix_cache.cached_pages,
+                serve_cow_copies=self.stats["cow_copies"],
+                serve_cache_evicted_pages=self.prefix_cache.stats[
+                    "evicted_pages"],
+            )
         self.metrics.update(
+            serve_role=self.role,
             serve_active=self.num_active,
             serve_waiting=len(self.waiting),
             serve_completed=len(self.completed),
@@ -401,4 +728,91 @@ class ContinuousBatchingEngine:
             serve_evictions=self.stats["evictions"],
             serve_compiles=self.stats["compiles"],
             serve_decode_steps=self.stats["decode_steps"],
+            **extra,
         )
+
+
+class DisaggregatedServe:
+    """A prefill-role + decode-role engine pair behind the single-engine
+    interface (submit/step/has_work/completed), with the explicit KV
+    handoff ferried between their pools each step. Pages cross the
+    boundary as fixed-width device blocks, so both engines keep their
+    one-compile-per-shape discipline."""
+
+    def __init__(self, prefill_engine: ContinuousBatchingEngine,
+                 decode_engine: ContinuousBatchingEngine):
+        if prefill_engine.role != "prefill" or decode_engine.role != "decode":
+            raise ValueError("DisaggregatedServe takes (prefill-role, "
+                             "decode-role) engines in that order")
+        if prefill_engine.table_width != decode_engine.table_width or \
+                prefill_engine.spec.page_size != decode_engine.spec.page_size:
+            raise ValueError("prefill/decode cache geometry mismatch: "
+                             "handoff blocks must agree on page size and "
+                             "table width")
+        if prefill_engine.max_model_len != decode_engine.max_model_len:
+            raise ValueError("prefill/decode max_model_len mismatch")
+        self.prefill_engine = prefill_engine
+        self.decode_engine = decode_engine
+
+    def warmup(self) -> int:
+        return self.prefill_engine.warmup() + self.decode_engine.warmup()
+
+    def submit(self, req: Request) -> None:
+        self.prefill_engine.submit(req)
+
+    @property
+    def waiting(self):
+        return self.prefill_engine.waiting
+
+    @property
+    def num_active(self) -> int:
+        return (self.prefill_engine.num_active
+                + self.decode_engine.num_active
+                + len(self.prefill_engine.handoffs)
+                + len(self.decode_engine._inbox))
+
+    @property
+    def has_work(self) -> bool:
+        return (self.prefill_engine.has_work or self.decode_engine.has_work
+                or bool(self.prefill_engine.handoffs))
+
+    @property
+    def completed(self) -> list[Request]:
+        return self.prefill_engine.completed + self.decode_engine.completed
+
+    @property
+    def max_model_len(self) -> int:
+        return self.prefill_engine.max_model_len
+
+    @property
+    def prefix_cache(self):
+        return self.prefill_engine.prefix_cache
+
+    def prefix_hit_rate(self) -> float:
+        return self.prefill_engine.prefix_hit_rate()
+
+    @property
+    def stats(self) -> dict:
+        merged = dict(self.prefill_engine.stats)
+        for k, v in self.decode_engine.stats.items():
+            merged[k] = merged.get(k, 0) + v
+        return merged
+
+    def step(self, admit: bool = True) -> int:
+        produced = self.prefill_engine.step(admit=admit)
+        for handoff in self.prefill_engine.take_handoffs():
+            self.decode_engine.ingest(handoff)
+        for req in self.decode_engine.take_requeued():
+            self.prefill_engine.waiting.appendleft(req)
+        produced += self.decode_engine.step()
+        return produced
+
+    def run(self, max_steps: int = 100000) -> list[Request]:
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"disaggregated pair did not drain in "
+                                   f"{max_steps} steps")
+        return self.completed

@@ -69,11 +69,19 @@ def pages_for_tokens(num_tokens: int, page_size: int) -> int:
 
 
 class PagePool:
-    """Host-side free list over physical page ids ``[RESERVED, num_pages)``.
+    """Host-side refcounted free list over page ids ``[RESERVED, num_pages)``.
 
     LIFO reuse keeps recently-freed pages hot; determinism matters more
     than locality here — same admission order, same page tables, so
     same-seed serve runs are bit-reproducible.
+
+    Refcounts make prefix sharing safe: ``alloc`` hands out private pages
+    (refcount 1), ``share`` adds an owner to an existing page, and a page
+    only returns to the free list once every owner has released it. The
+    engine's copy-on-write trigger is exactly ``refcount(page) > 1`` at
+    the moment a write would land in it. Invariants are enforced loudly:
+    the scratch page is never allocated or shared, a refcount can never
+    go negative, and releasing a page twice through the same owner raises.
     """
 
     def __init__(self, num_pages: int):
@@ -85,6 +93,7 @@ class PagePool:
         self._free: list[int] = list(range(num_pages - 1, RESERVED_PAGES - 1,
                                            -1))
         self._owned: dict[str, list[int]] = {}
+        self._refs: dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
@@ -93,22 +102,62 @@ class PagePool:
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
+    def refcount(self, page: int) -> int:
+        """Owners currently holding ``page`` (0 = free)."""
+        return self._refs.get(page, 0)
+
     def alloc(self, request_id: str, n: int) -> list[int]:
-        """Take ``n`` pages for ``request_id``; raises if short (callers
-        check ``can_alloc`` first — admission control, not exceptions,
-        decides who runs)."""
+        """Take ``n`` private pages for ``request_id``; raises if short
+        (callers check ``can_alloc`` first — admission control, not
+        exceptions, decides who runs)."""
         if n > len(self._free):
             raise MemoryError(
                 f"page pool exhausted: want {n}, have {len(self._free)}")
         pages = [self._free.pop() for _ in range(n)]
         self._owned.setdefault(request_id, []).extend(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
+    def share(self, request_id: str, pages: list[int]) -> None:
+        """Add ``request_id`` as an owner of already-allocated ``pages``."""
+        for p in pages:
+            if p < RESERVED_PAGES:
+                raise ValueError(f"page {p} is reserved scratch")
+            if self._refs.get(p, 0) <= 0:
+                raise ValueError(f"page {p} is free; cannot share")
+        self._owned.setdefault(request_id, []).extend(pages)
+        for p in pages:
+            self._refs[p] += 1
+
+    def drop(self, request_id: str, page: int) -> None:
+        """Release ONE reference ``request_id`` holds on ``page``."""
+        owned = self._owned.get(request_id)
+        if owned is None or page not in owned:
+            raise ValueError(
+                f"double free: {request_id!r} does not own page {page}")
+        owned.remove(page)
+        if not owned:
+            del self._owned[request_id]
+        self._unref(page)
+
     def free(self, request_id: str) -> int:
-        """Return every page owned by ``request_id``; idempotent."""
+        """Release every reference held by ``request_id``; idempotent."""
         pages = self._owned.pop(request_id, [])
-        self._free.extend(reversed(pages))
+        for p in reversed(pages):
+            self._unref(p)
         return len(pages)
+
+    def _unref(self, page: int) -> None:
+        rc = self._refs.get(page, 0)
+        if rc <= 0:
+            raise ValueError(f"refcount underflow on page {page}")
+        rc -= 1
+        if rc == 0:
+            del self._refs[page]
+            self._free.append(page)
+        else:
+            self._refs[page] = rc
 
     def owned(self, request_id: str) -> list[int]:
         return list(self._owned.get(request_id, ()))
@@ -148,6 +197,42 @@ def append_pages(pages: jax.Array, new: jax.Array, page_table: jax.Array,
     flat_new = new.reshape(B * S, Hkv, D).astype(pages.dtype)
     return pages.at[page_ids.reshape(-1), slots.reshape(-1)].set(
         flat_new, mode="drop")
+
+
+def copy_page(cache: dict, src: jax.Array, dst: jax.Array) -> dict:
+    """Copy-on-write: clone physical page ``src`` into ``dst`` across every
+    layer's K and V pools.
+
+    ``src``/``dst`` are scalar int32 page ids, so one compiled program
+    serves every COW event — the engine traces this once and replays it
+    whenever a write would land in a page whose refcount exceeds one.
+    """
+    def _cp(pages: jax.Array) -> jax.Array:
+        return pages.at[dst].set(pages[src])
+
+    return jax.tree.map(_cp, cache)
+
+
+def extract_pages(cache: dict, page_ids: jax.Array) -> dict:
+    """Gather a fixed-width block of physical pages from every pool.
+
+    ``page_ids`` is a [W] int32 vector padded with the scratch page, so
+    one compiled program covers every prefill→decode handoff regardless
+    of how many pages the sequence actually owns. Returns a pytree of
+    [W, page_size, Hkv, D] blocks.
+    """
+    return jax.tree.map(lambda pages: pages[page_ids], cache)
+
+
+def insert_pages(cache: dict, block: dict, page_ids: jax.Array) -> dict:
+    """Scatter an extracted block into this pool's pages at ``page_ids``.
+
+    Padded rows target the scratch page, so their stale contents collide
+    harmlessly on page 0 — the decode-side half of the KV handoff.
+    """
+    return jax.tree.map(
+        lambda pages, b: pages.at[page_ids].set(b.astype(pages.dtype)),
+        cache, block)
 
 
 def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:

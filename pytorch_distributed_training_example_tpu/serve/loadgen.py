@@ -33,6 +33,14 @@ class LoadSpec:
     vocab_size: int = 512
     eos_id: int | None = None    # None: length-bounded generation only
     seed: int = 0
+    # Skewed shared-prefix workload (the realistic serving distribution:
+    # a handful of system prompts / few-shot templates dominate traffic).
+    # num_templates > 0 prepends a template prefix to every prompt, with
+    # template popularity Zipf-distributed: p(rank k) ∝ 1 / k**zipf_a.
+    num_templates: int = 0
+    zipf_a: float = 1.2
+    prefix_len_min: int = 16
+    prefix_len_max: int = 32
 
 
 def generate_requests(spec: LoadSpec) -> list[Request]:
@@ -44,10 +52,24 @@ def generate_requests(spec: LoadSpec) -> list[Request]:
                                              spec.num_requests))
     else:
         arrivals = np.zeros(spec.num_requests)
+    templates: list[list[int]] = []
+    weights = None
+    if spec.num_templates > 0:
+        for _ in range(spec.num_templates):
+            tlen = int(rng.integers(spec.prefix_len_min,
+                                    spec.prefix_len_max + 1))
+            templates.append(rng.integers(1, spec.vocab_size, tlen).tolist())
+        # Explicit ranked-probability Zipf (``rng.zipf`` is unbounded).
+        ranks = np.arange(1, spec.num_templates + 1, dtype=np.float64)
+        weights = ranks ** -spec.zipf_a
+        weights /= weights.sum()
     out = []
     for i in range(spec.num_requests):
         plen = int(rng.integers(spec.prompt_len_min, spec.prompt_len_max + 1))
         prompt = rng.integers(1, spec.vocab_size, plen).tolist()
+        if templates:
+            t = int(rng.choice(spec.num_templates, p=weights))
+            prompt = templates[t] + prompt
         max_new = int(rng.integers(spec.max_new_min, spec.max_new_max + 1))
         out.append(Request(request_id=f"req{i:04d}", prompt=prompt,
                            max_new_tokens=max_new, eos_id=spec.eos_id,

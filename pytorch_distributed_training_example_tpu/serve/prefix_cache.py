@@ -1,0 +1,236 @@
+"""Prefix cache: a hash-keyed tree over token chunks mapping to pool pages.
+
+N concurrent users of one system prompt should pay its prefill once.
+The tree stores one node per ``page_size``-token chunk of previously
+prefilled prompts; each node pins one physical page in the ``PagePool``
+(under the cache's own owner id), and ``_admit`` splices matched pages
+into a new request's page table instead of recomputing their KV. This is
+SGLang's RadixAttention idea restricted to page granularity, which is
+what our vLLM-style ``PagePool`` supports natively (PAPERS.md).
+
+Two node flavors:
+
+- FULL nodes hold exactly ``page_size`` tokens. Their pages are safe to
+  share zero-copy: decode appends only ever land past a sequence's
+  current length, so a full page that entered the cache is never written
+  through any follower's table — unless the follower's *last prompt
+  token* falls inside it (the fully-cached-prompt clamp), in which case
+  the engine copy-on-writes that single page before prefilling it.
+- PARTIAL nodes hold a sub-page tail chunk (< page_size tokens). They
+  match on longest common prefix and their pages are shared
+  copy-on-write: the first divergent write (a follower's differing
+  prompt tail, or the publishing request's own next decode token)
+  triggers a page copy in the engine. Stale tokens past the matched
+  length are masked by position, exactly like pool garbage.
+
+Correctness rests on KV determinism: the KV vector at position ``p`` is
+a pure function of tokens ``[0, p]`` (causal attention, RoPE applied at
+absolute positions), so cached pages are valid under any continuation.
+
+The cache NEVER touches device memory. It is a host-side index: the
+engine owns the compiled COW/prefill programs; this module only decides
+which page ids to splice, pin, and evict. Eviction is LRU over
+unreferenced leaves (``refs == 0``), aged by a monotonic counter — no
+wall clock, so same-seed runs evict identically (GL005).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .kv_cache import PagePool
+
+CACHE_OWNER = "__prefix_cache__"  # PagePool owner id for pinned pages
+
+
+class _Node:
+    """One cached chunk: ``chunk`` tokens living in physical ``page``."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "partials", "refs",
+                 "last_use")
+
+    def __init__(self, chunk: tuple, page: int, parent: "_Node | None"):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}   # full chunks, keyed by tokens
+        self.partials: dict[tuple, _Node] = {}   # sub-page tails
+        self.refs = 0          # requests currently pinning this node
+        self.last_use = 0      # monotonic tick, for LRU
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children and not self.partials
+
+
+def _common_prefix(a: tuple, b: tuple) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of a tree walk: pages to splice and how many tokens they
+    cover after the first-token clamp (the engine must still prefill at
+    least the final prompt token to get logits)."""
+
+    nodes: list
+    tokens: int
+
+    @property
+    def pages(self) -> list[int]:
+        return [n.page for n in self.nodes]
+
+
+class PrefixCache:
+    """Host-side prefix tree pinning pages in a shared ``PagePool``."""
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = page_size
+        self._root = _Node((), -1, None)
+        self._tick = 0
+        self._nodes = 0
+        self.stats = {"inserted_pages": 0, "evicted_pages": 0}
+
+    @property
+    def cached_pages(self) -> int:
+        return self._nodes
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_use = self._tick
+
+    # ------------------------------------------------------------------
+    # lookup / pin / unpin
+
+    def match(self, prompt: list[int], max_tokens: int) -> PrefixMatch:
+        """Longest cached prefix of ``prompt``, capped at ``max_tokens``
+        usable tokens (callers pass ``len(prompt) - 1`` so the final
+        prompt token is always prefilled for its logits)."""
+        ps = self.page_size
+        prompt_t = tuple(prompt)
+        nodes: list[_Node] = []
+        node = self._root
+        pos = 0
+        if max_tokens <= 0:
+            return PrefixMatch([], 0)
+        while pos + ps <= len(prompt_t):
+            child = node.children.get(prompt_t[pos:pos + ps])
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+            pos += ps
+            if pos >= max_tokens:
+                # Last full node covers the clamp point; writes into it
+                # go through the engine's COW path.
+                return PrefixMatch(nodes, max_tokens)
+        # Best partial tail under the deepest full node: longest common
+        # prefix wins, insertion order (dict order) breaks ties.
+        remainder = prompt_t[pos:]
+        best: Optional[_Node] = None
+        best_n = 0
+        if remainder:
+            for part in node.partials.values():
+                n = _common_prefix(part.chunk, remainder)
+                if n > best_n:
+                    best, best_n = part, n
+        if best is not None:
+            nodes.append(best)
+            pos += best_n
+        return PrefixMatch(nodes, min(pos, max_tokens))
+
+    def acquire(self, match: PrefixMatch, request_id: str) -> None:
+        """Pin matched nodes for ``request_id``: bumps node refs and adds
+        the request as a pool owner of every matched page."""
+        for node in match.nodes:
+            node.refs += 1
+            self._touch(node)
+        self.pool.share(request_id, match.pages)
+
+    def release(self, nodes: list) -> None:
+        """Unpin nodes (pool refs are released by the engine via
+        ``pool.free``/``pool.drop`` — this only drops the tree pins that
+        guard against eviction)."""
+        for node in nodes:
+            if node.refs <= 0:
+                raise ValueError("prefix-cache node ref underflow")
+            node.refs -= 1
+
+    # ------------------------------------------------------------------
+    # insert / evict
+
+    def insert(self, prompt: list[int], pages: list[int]) -> int:
+        """Register a freshly prefilled prompt's pages.
+
+        ``pages[i]`` holds tokens ``[i*ps, (i+1)*ps)``. Chunks already in
+        the tree are skipped (the request's duplicate page simply stays
+        private); new full chunks and a sub-page tail, if any, become
+        nodes pinning their page under ``CACHE_OWNER``. Returns the
+        number of pages newly pinned.
+        """
+        ps = self.page_size
+        prompt_t = tuple(prompt)
+        node = self._root
+        added = 0
+        pos = 0
+        while pos + ps <= len(prompt_t):
+            chunk = prompt_t[pos:pos + ps]
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, pages[pos // ps], node)
+                node.children[chunk] = child
+                self.pool.share(CACHE_OWNER, [child.page])
+                self._nodes += 1
+                added += 1
+            self._touch(child)
+            node = child
+            pos += ps
+        tail = prompt_t[pos:]
+        if tail and tail not in node.partials:
+            part = _Node(tail, pages[pos // ps], node)
+            node.partials[tail] = part
+            self.pool.share(CACHE_OWNER, [part.page])
+            self._nodes += 1
+            added += 1
+            self._touch(part)
+        self.stats["inserted_pages"] += added
+        return added
+
+    def evict(self, n: int) -> int:
+        """Drop up to ``n`` unreferenced LEAF nodes, oldest first,
+        releasing the cache's pool pin on each (the page only returns to
+        the free list once every other owner releases it too). Interior
+        nodes become evictable once their subtrees go; one sweep per
+        call keeps the cost bounded and deterministic."""
+        evicted = 0
+        while evicted < n:
+            victim: Optional[_Node] = None
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                for group in (node.children, node.partials):
+                    for child in group.values():
+                        if child.is_leaf and child.refs == 0:
+                            if victim is None or child.last_use < victim.last_use:
+                                victim = child
+                        else:
+                            stack.append(child)
+            if victim is None:
+                break
+            parent = victim.parent
+            if victim.chunk in parent.children and \
+                    parent.children[victim.chunk] is victim:
+                del parent.children[victim.chunk]
+            else:
+                del parent.partials[victim.chunk]
+            self.pool.drop(CACHE_OWNER, victim.page)
+            self._nodes -= 1
+            evicted += 1
+        self.stats["evicted_pages"] += evicted
+        return evicted

@@ -1,0 +1,243 @@
+"""Front-end router: prefix-affinity placement across serve replicas.
+
+Prefix caches are PER-REPLICA (each engine owns its own ``PagePool``),
+so fleet-level hit rate depends on placement: two requests sharing a
+system prompt only share KV if they land on the same replica. The
+router keys each request by the rolling hash chain of its full
+``page_size``-token chunks and remembers which replica last served each
+chain link; a new request goes to the replica owning its LONGEST hashed
+prefix (that replica's tree has those pages), falling back to the
+least-loaded replica (active + waiting, lowest index on ties —
+deterministic, GL005). ``policy="least_loaded"`` disables affinity for
+A/B runs.
+
+Failure handling rides the existing drain-on-SIGTERM semantics:
+
+- ``drain(name)`` — the replica stops taking new work; its queued
+  (waiting) requests re-route immediately, its in-flight requests finish
+  locally via ``step(admit=False)`` and the replica leaves the rotation
+  once idle. Zero drops.
+- ``kill(name)`` — hard loss: everything incomplete on the replica
+  (queued AND in-flight) re-routes with runtime state reset, so greedy
+  recompute regenerates the identical token stream elsewhere. Zero
+  drops, at recompute cost.
+
+Replicas are any engine-shaped object (``ContinuousBatchingEngine`` or
+``DisaggregatedServe``): submit/step/has_work/num_active/waiting/
+completed. The router itself exposes the same protocol, so
+``serve_loop`` and the open-loop driver run unchanged against it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pytorch_distributed_training_example_tpu.serve.engine import Request
+
+_HASH_MASK = (1 << 61) - 1
+
+
+def chunk_keys(prompt: list[int], page_size: int) -> list[int]:
+    """Rolling hash chain over the prompt's full page-size chunks: key i
+    summarizes tokens [0, (i+1)*page_size). Process-stable (no ``hash``)
+    so router decisions replay across runs and machines."""
+    keys = []
+    h = 0
+    for i in range(len(prompt) // page_size):
+        for tok in prompt[i * page_size:(i + 1) * page_size]:
+            h = (h * 1000003 + tok + 1) & _HASH_MASK
+        keys.append(h)
+    return keys
+
+
+@dataclasses.dataclass
+class _ReplicaState:
+    engine: object
+    alive: bool = True      # taking new placements
+    draining: bool = False  # finishing in-flight work before leaving
+
+
+class PrefixAffinityRouter:
+    """Spread an open-loop stream over replicas, prefix-affinity first."""
+
+    def __init__(self, replicas: dict[str, object], page_size: int,
+                 policy: str = "affinity"):
+        if policy not in ("affinity", "least_loaded"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.policy = policy
+        self.page_size = page_size
+        self._replicas = {name: _ReplicaState(eng)
+                          for name, eng in replicas.items()}
+        self._owner: dict[int, str] = {}       # chunk key -> replica name
+        self._placed: dict[str, str] = {}      # request id -> replica name
+        self.stats = {"routed": 0, "affinity_hits": 0, "rerouted": 0,
+                      "drained": 0, "killed": 0}
+
+    # ------------------------------------------------------------- placement
+
+    def _alive(self) -> list[str]:
+        return [n for n, s in self._replicas.items() if s.alive]
+
+    def _load(self, name: str) -> int:
+        eng = self._replicas[name].engine
+        return eng.num_active + len(eng.waiting)
+
+    def route(self, req: Request) -> str:
+        """Pick a replica: deepest owned chunk-chain link wins, else
+        least-loaded; record ownership of the request's whole chain."""
+        alive = self._alive()
+        if not alive:
+            raise RuntimeError("no live replicas")
+        keys = chunk_keys(req.prompt, self.page_size)
+        choice = None
+        if self.policy == "affinity":
+            for key in reversed(keys):
+                owner = self._owner.get(key)
+                if owner is not None and self._replicas[owner].alive:
+                    choice = owner
+                    self.stats["affinity_hits"] += 1
+                    break
+        if choice is None:
+            choice = min(alive, key=lambda n: (self._load(n), n))
+        for key in keys:
+            self._owner[key] = choice
+        return choice
+
+    def submit(self, req: Request) -> None:
+        name = self.route(req)
+        self._placed[req.request_id] = name
+        self._replicas[name].engine.submit(req)
+        self.stats["routed"] += 1
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _reroute(self, req: Request) -> None:
+        """Re-place a request displaced from a lost replica, with runtime
+        state reset so greedy recompute reproduces its exact stream."""
+        req.generated.clear()
+        req.token_times.clear()
+        req.first_token_t = None
+        req.evictions += 1
+        name = self.route(req)
+        self._placed[req.request_id] = name
+        self._replicas[name].engine.submit(req)
+        self.stats["rerouted"] += 1
+
+    def drain(self, name: str) -> int:
+        """SIGTERM semantics: stop placements, re-route the queue, let
+        in-flight requests finish locally. Returns requests re-routed."""
+        state = self._replicas[name]
+        if not state.alive:
+            return 0
+        state.alive = False
+        state.draining = True
+        self.stats["drained"] += 1
+        moved = 0
+        while state.engine.waiting:
+            self._reroute(state.engine.waiting.popleft())
+            moved += 1
+        return moved
+
+    def kill(self, name: str) -> int:
+        """Hard replica loss: everything incomplete re-routes (in-flight
+        requests lose their pages and recompute elsewhere)."""
+        state = self._replicas[name]
+        was_alive = state.alive
+        state.alive = False
+        state.draining = False
+        self.stats["killed"] += was_alive
+        moved = 0
+        while state.engine.waiting:
+            self._reroute(state.engine.waiting.popleft())
+            moved += 1
+        for req in list(getattr(state.engine, "slots", [])):
+            if req is not None:
+                self._reroute(req)
+                moved += 1
+        # A DisaggregatedServe replica holds in-flight work in both
+        # engines plus the handoff queues.
+        for attr in ("prefill_engine", "decode_engine"):
+            sub = getattr(state.engine, attr, None)
+            if sub is None:
+                continue
+            while sub.waiting:
+                self._reroute(sub.waiting.popleft())
+                moved += 1
+            for req in sub.slots:
+                if req is not None:
+                    self._reroute(req)
+                    moved += 1
+            for h in sub.take_handoffs():
+                self._reroute(h.req)
+                moved += 1
+            while sub._inbox:
+                self._reroute(sub._inbox.popleft().req)
+                moved += 1
+        return moved
+
+    # ---------------------------------------------------------- engine shape
+
+    @property
+    def num_active(self) -> int:
+        return sum(s.engine.num_active for s in self._replicas.values()
+                   if s.alive or s.draining)
+
+    @property
+    def waiting(self) -> list[Request]:
+        out = []
+        for state in self._replicas.values():
+            out.extend(state.engine.waiting)
+        return out
+
+    @property
+    def has_work(self) -> bool:
+        return any(s.engine.has_work for s in self._replicas.values()
+                   if s.alive or s.draining)
+
+    @property
+    def completed(self) -> list[Request]:
+        out = []
+        for state in self._replicas.values():
+            out.extend(state.engine.completed)
+        return out
+
+    def step(self, admit: bool = True) -> int:
+        """One iteration across the fleet (deterministic replica order).
+        Draining replicas run admit-free until their last in-flight
+        request completes, then leave the rotation."""
+        produced = 0
+        for state in self._replicas.values():
+            if state.alive:
+                produced += state.engine.step(admit=admit)
+            elif state.draining:
+                produced += state.engine.step(admit=False)
+                if not state.engine.has_work:
+                    state.draining = False
+        return produced
+
+    def run(self, max_steps: int = 100000) -> list[Request]:
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"router did not drain in {max_steps} steps")
+        return self.completed
+
+    def fleet_stats(self) -> dict:
+        """Router counters plus per-replica engine stats and hit rates."""
+        per = {}
+        for name, state in self._replicas.items():
+            eng = state.engine
+            per[name] = {
+                "completed": len(eng.completed),
+                "alive": state.alive,
+                "stats": dict(eng.stats),
+                "prefix_hit_rate": (eng.prefix_hit_rate()
+                                    if hasattr(eng, "prefix_hit_rate")
+                                    else 0.0),
+            }
+        return {**self.stats, "replicas": per}

@@ -89,12 +89,40 @@ def main(cfg) -> dict:
     spec = engine_lib.spec_for_module(module, num_pages=cfg.serve_num_pages,
                                       page_size=cfg.serve_page_size)
     buckets = lambda s: tuple(int(t) for t in s.split(",") if t)
-    eng = engine_lib.ContinuousBatchingEngine(
-        module, params, spec,
-        decode_buckets=buckets(cfg.serve_decode_buckets),
-        prompt_buckets=buckets(cfg.serve_prompt_buckets),
-        max_model_len=cfg.serve_max_model_len or None, metrics=metrics)
-    eng.warmup()
+
+    def build_replica():
+        """One serve replica: a single engine, or a prefill/decode pair
+        under --serve-disaggregate. All replicas share module + params
+        (one process, one set of weights) but own separate page pools."""
+        kw = dict(decode_buckets=buckets(cfg.serve_decode_buckets),
+                  prompt_buckets=buckets(cfg.serve_prompt_buckets),
+                  max_model_len=cfg.serve_max_model_len or None,
+                  metrics=metrics)
+        if cfg.serve_disaggregate:
+            return engine_lib.DisaggregatedServe(
+                engine_lib.ContinuousBatchingEngine(
+                    module, params, spec, role="prefill",
+                    prefix_cache=cfg.serve_prefix_cache,
+                    prefill_chunk=cfg.serve_prefill_chunk, **kw),
+                engine_lib.ContinuousBatchingEngine(
+                    module, params, spec, role="decode", **kw))
+        return engine_lib.ContinuousBatchingEngine(
+            module, params, spec, prefix_cache=cfg.serve_prefix_cache,
+            prefill_chunk=cfg.serve_prefill_chunk, **kw)
+
+    if cfg.serve_replicas > 1:
+        from pytorch_distributed_training_example_tpu.serve import (
+            router as router_lib)
+
+        replicas = {f"replica{i}": build_replica()
+                    for i in range(cfg.serve_replicas)}
+        for rep in replicas.values():
+            rep.warmup()
+        eng = router_lib.PrefixAffinityRouter(
+            replicas, page_size=cfg.serve_page_size, policy=cfg.serve_route)
+    else:
+        eng = build_replica()
+        eng.warmup()
 
     # The synthetic stream must fit what the engine was warmed for: prompts
     # no longer than the largest prompt bucket, prompt + new tokens within
@@ -102,13 +130,21 @@ def main(cfg) -> dict:
     plen_cap = max(buckets(cfg.serve_prompt_buckets))
     len_budget = (cfg.serve_max_model_len or module.max_seq_len) - plen_cap
     defaults = loadgen.LoadSpec()
+    # Template prefix + random suffix together must fit the prompt cap.
+    pfx_min_s, _, pfx_max_s = cfg.serve_prefix_len.partition(":")
+    pfx_max = min(int(pfx_max_s or pfx_min_s),
+                  plen_cap - defaults.prompt_len_min)
+    pfx_min = min(int(pfx_min_s), pfx_max)
+    suffix_cap = plen_cap - (pfx_max if cfg.serve_templates else 0)
     requests = loadgen.generate_requests(loadgen.LoadSpec(
         num_requests=cfg.serve_requests, rate=cfg.serve_rate,
-        prompt_len_min=min(defaults.prompt_len_min, plen_cap),
-        prompt_len_max=min(defaults.prompt_len_max, plen_cap),
+        prompt_len_min=min(defaults.prompt_len_min, suffix_cap),
+        prompt_len_max=max(1, min(defaults.prompt_len_max, suffix_cap)),
         max_new_min=max(1, min(defaults.max_new_min, len_budget)),
         max_new_max=max(1, min(defaults.max_new_max, len_budget)),
-        vocab_size=int(module.vocab_size), seed=cfg.seed))
+        vocab_size=int(module.vocab_size), seed=cfg.seed,
+        num_templates=cfg.serve_templates, zipf_a=cfg.serve_zipf_a,
+        prefix_len_min=pfx_min, prefix_len_max=pfx_max))
     # SIGTERM becomes a bounded drain + exit 75 instead of a mid-step death
     # (the scheduler's preemption contract). Install is idempotent and a
     # no-op off the main thread (in-process tests drive serve_loop directly).
@@ -118,24 +154,49 @@ def main(cfg) -> dict:
                          drain_timeout_s=cfg.serve_drain_timeout)
     wall = outcome["wall_s"]
 
-    ttfts = sorted(r.ttft_s for r in eng.completed if r.ttft_s is not None)
+    completed = eng.completed
+    if cfg.serve_replicas > 1:
+        fleet = eng.fleet_stats()
+        stats = {}
+        for rep in fleet["replicas"].values():
+            for k, v in rep["stats"].items():
+                stats[k] = stats.get(k, 0) + v
+    else:
+        fleet = None
+        stats = dict(eng.stats)
+    ttfts = sorted(r.ttft_s for r in completed if r.ttft_s is not None)
     result = {
         "mode": "serve",
         "model": cfg.model,
         "restored_step": restored_step,
-        "requests_completed": len(eng.completed),
-        "tokens_generated": eng.stats["tokens_generated"],
-        "tokens_per_s": round(eng.stats["tokens_generated"]
+        "requests_completed": len(completed),
+        "tokens_generated": stats["tokens_generated"],
+        "tokens_per_s": round(stats["tokens_generated"]
                               / max(wall, 1e-9), 2),
         "ttft_p50_ms": (round(1e3 * float(np.percentile(ttfts, 50)), 3)
                         if ttfts else None),
-        "compiles": eng.stats["compiles"],
-        "decode_steps": eng.stats["decode_steps"],
-        "evictions": eng.stats["evictions"],
+        "compiles": stats["compiles"],
+        "decode_steps": stats["decode_steps"],
+        "evictions": stats["evictions"],
         "metrics_port": metrics.port if metrics is not None else None,
         "preempted": outcome["preempted"],
         "drained": outcome["drained"],
     }
+    if cfg.serve_prefix_cache:
+        result["prefix_cache"] = {
+            "hit_rate": round(stats["cached_tokens"]
+                              / max(stats["prompt_tokens"], 1), 4),
+            "cached_tokens": stats["cached_tokens"],
+            "cow_copies": stats["cow_copies"],
+        }
+    if cfg.serve_disaggregate:
+        result["handoffs"] = stats["handoffs_out"]
+    if fleet is not None:
+        result["router"] = {k: v for k, v in fleet.items()
+                            if k != "replicas"}
+        result["router"]["per_replica_completed"] = {
+            name: rep["completed"]
+            for name, rep in fleet["replicas"].items()}
     if metrics is not None:
         metrics.stop()
     print(json.dumps(result, indent=2))

@@ -148,6 +148,18 @@ class Config:
     # finish decoding before the session exits PREEMPTED_EXIT_CODE (the
     # fleet scheduler's preemption contract for serving jobs).
     serve_drain_timeout: float = 5.0
+    # r17 serving-throughput stack (serve/prefix_cache.py, serve/router.py):
+    # prefix caching, chunked prefill + prefill/decode disaggregation, and
+    # multi-replica prefix-affinity routing over one process's devices.
+    serve_prefix_cache: bool = False
+    serve_prefill_chunk: int = 0      # tokens/window; 0 = whole prompt
+    serve_disaggregate: bool = False  # prefill-role + decode-role pair
+    serve_replicas: int = 1
+    serve_route: str = "affinity"     # affinity | least_loaded
+    # Shared-prefix synthetic workload (Zipf-popular prompt templates).
+    serve_templates: int = 0
+    serve_zipf_a: float = 1.2
+    serve_prefix_len: str = "16:32"   # template length range, "min:max"
 
     def mesh_config(self) -> dict[str, int]:
         return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,

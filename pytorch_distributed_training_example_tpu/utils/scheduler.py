@@ -116,6 +116,10 @@ class JobSpec:
     after: str | None = None          # submit once this job has...
     after_event: str = "start"        # ..."start"-ed or written a "checkpoint"
     env: tuple[tuple[str, str], ...] = ()  # extra child env (sorted pairs)
+    # "train" (default) or "serve". Serving jobs drain on SIGTERM instead of
+    # checkpoint-and-yield (serve/run.py), so the fleet surfaces them
+    # separately and the launcher exports PDTX_JOB_KIND to the child.
+    kind: str = "train"
 
     @property
     def checkpoint_dir(self) -> str | None:
@@ -179,6 +183,10 @@ def load_jobs(path: str) -> tuple[int, list[JobSpec]]:
         if after_event not in ("start", "checkpoint"):
             raise ValueError(f"job {name!r}: after_event must be 'start' or "
                              f"'checkpoint', got {after_event!r}")
+        kind = str(row.get("kind", "train"))
+        if kind not in ("train", "serve"):
+            raise ValueError(f"job {name!r}: kind must be 'train' or "
+                             f"'serve', got {kind!r}")
         specs.append(JobSpec(
             name=name, cmd=cmd, priority=int(row.get("priority", 0)),
             min_world=min_world, max_world=max_world,
@@ -186,7 +194,8 @@ def load_jobs(path: str) -> tuple[int, list[JobSpec]]:
             backoff_s=float(row.get("backoff_s", 1.0)),
             after=row.get("after"), after_event=after_event,
             env=tuple(sorted((str(k), str(v))
-                             for k, v in (row.get("env") or {}).items()))))
+                             for k, v in (row.get("env") or {}).items())),
+            kind=kind))
     if not specs:
         raise ValueError("jobs.json has no jobs")
     for s in specs:
@@ -272,6 +281,8 @@ class FleetScheduler:
         }
         for status in (PENDING, RUNNING, PREEMPTING, BACKOFF, DONE, FAILED):
             out[f"fleet_jobs_{status}"] = by_status.get(status, 0)
+        out["fleet_jobs_serve"] = sum(
+            1 for st in self.jobs.values() if st.spec.kind == "serve")
         for name in sorted(self.jobs):
             st = self.jobs[name]
             out[f"fleet_job_world_{name}"] = st.world

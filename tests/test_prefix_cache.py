@@ -1,0 +1,330 @@
+"""Prefix cache: refcounted pool sharing, COW, tree index, engine identity.
+
+The load-bearing claim stays TOKEN IDENTITY: a cache hit splices already-
+computed KV pages into a new request's table, and the request must still
+produce the exact greedy continuation a cold engine (or the full training
+forward) produces — including when a shared page is copy-on-written at the
+divergence point. The pool's refcount invariants are what make the sharing
+sound, so they are tested loudly and first.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.serve import (
+    engine as engine_lib, kv_cache)
+from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+    CacheSpec, PagePool, RESERVED_PAGES)
+from pytorch_distributed_training_example_tpu.serve.prefix_cache import (
+    CACHE_OWNER, PrefixCache)
+
+
+# ---------------------------------------------------------------------------
+# PagePool refcount invariants
+# ---------------------------------------------------------------------------
+
+
+def test_pool_share_and_drop_refcounts():
+    pool = PagePool(8)
+    (p,) = pool.alloc("a", 1)
+    assert pool.refcount(p) == 1
+    pool.share("b", [p])
+    pool.share("c", [p])
+    assert pool.refcount(p) == 3
+    pool.free("a")
+    assert pool.refcount(p) == 2 and p not in pool._free
+    pool.drop("b", p)
+    assert pool.refcount(p) == 1
+    pool.free("c")
+    assert pool.refcount(p) == 0 and pool.num_free == 7
+
+
+def test_pool_double_free_raises():
+    pool = PagePool(8)
+    (p,) = pool.alloc("a", 1)
+    pool.drop("a", p)
+    with pytest.raises(ValueError, match="double free"):
+        pool.drop("a", p)
+    # free() stays idempotent (retire + evict racing is a no-op)...
+    pool.free("a")
+    # ...but a stale owner re-releasing a freed page would underflow, and
+    # share() of a free page is refused before it can corrupt the list.
+    with pytest.raises(ValueError, match="free"):
+        pool.share("b", [p])
+
+
+def test_pool_refcount_never_negative():
+    pool = PagePool(8)
+    (p,) = pool.alloc("a", 1)
+    pool.share("b", [p])
+    pool.free("a")
+    pool.free("b")
+    with pytest.raises(ValueError, match="underflow"):
+        pool._unref(p)
+    assert pool.refcount(p) == 0
+
+
+def test_pool_scratch_page_is_never_shared_or_allocated():
+    pool = PagePool(4)
+    pages = pool.alloc("a", 3)  # drains the whole pool
+    assert 0 not in pages
+    with pytest.raises(ValueError, match="reserved"):
+        pool.share("b", [0])
+
+
+def test_pool_alloc_after_free_reuse_is_deterministic():
+    """LIFO free list: two same-seed runs that free and re-allocate in the
+    same order get bit-identical page tables."""
+    def trace():
+        pool = PagePool(16)
+        a = pool.alloc("a", 3)
+        b = pool.alloc("b", 4)
+        pool.share("c", b[:2])
+        pool.free("a")
+        pool.free("b")          # shared pages survive under "c"
+        c = pool.alloc("d", 5)
+        pool.free("c")
+        return a, b, c, pool.alloc("e", 2)
+
+    assert trace() == trace()
+
+
+# ---------------------------------------------------------------------------
+# COW device op: mutating one stream's copy leaves the original bytes intact
+# ---------------------------------------------------------------------------
+
+
+def test_copy_page_isolates_writer_from_sharer():
+    spec = CacheSpec(num_layers=2, num_pages=8, page_size=4, num_kv_heads=2,
+                     head_dim=4)
+    cache = kv_cache.init_cache(spec)
+    rng = np.random.default_rng(0)
+    # Request A prefills page 3 with real KV.
+    table_a = jnp.asarray([[3]], jnp.int32)
+    kv = {}
+    for pos in range(4):
+        positions = jnp.full((1, 1), pos, jnp.int32)
+        for layer in cache.values():
+            for name in ("k_pages", "v_pages"):
+                new = rng.standard_normal((1, 1, 2, 4)).astype(np.float32)
+                kv.setdefault(id(layer["attn"]), {}).setdefault(
+                    name, []).append(new)
+                layer["attn"][name] = kv_cache.append_pages(
+                    layer["attn"][name], jnp.asarray(new), table_a, positions)
+    before = jax.tree.map(lambda x: np.asarray(x[3]).copy(), cache)
+    # Request B shares page 3, then copy-on-writes it into page 5 and
+    # scribbles over its copy.
+    cache = kv_cache.copy_page(cache, jnp.int32(3), jnp.int32(5))
+    after_copy = jax.tree.map(lambda x: np.asarray(x[5]), cache)
+    jax.tree.map(np.testing.assert_array_equal, after_copy, before)
+    table_b = jnp.asarray([[5]], jnp.int32)
+    for pos in range(2, 4):  # divergent rewrite of the tail slots
+        positions = jnp.full((1, 1), pos, jnp.int32)
+        garbage = jnp.full((1, 1, 2, 4), 99.0)
+        for layer in cache.values():
+            for name in ("k_pages", "v_pages"):
+                layer["attn"][name] = kv_cache.append_pages(
+                    layer["attn"][name], garbage, table_b, positions)
+    after = jax.tree.map(lambda x: np.asarray(x[3]), cache)
+    jax.tree.map(np.testing.assert_array_equal, after, before)
+
+
+def test_extract_insert_round_trip():
+    spec = CacheSpec(num_layers=1, num_pages=8, page_size=4, num_kv_heads=2,
+                     head_dim=4)
+    rng = np.random.default_rng(3)
+    src = {"block_0": {"attn": {
+        "k_pages": jnp.asarray(rng.standard_normal(spec.layer_shape()),
+                               jnp.float32),
+        "v_pages": jnp.asarray(rng.standard_normal(spec.layer_shape()),
+                               jnp.float32)}}}
+    dst = kv_cache.init_cache(spec)
+    # Width-3 handoff of 2 real pages; the pad row targets scratch page 0.
+    ids_out = jnp.asarray([6, 2, 0], jnp.int32)
+    block = kv_cache.extract_pages(src, ids_out)
+    ids_in = jnp.asarray([1, 5, 0], jnp.int32)
+    dst = kv_cache.insert_pages(dst, block, ids_in)
+    for name in ("k_pages", "v_pages"):
+        s = np.asarray(src["block_0"]["attn"][name])
+        d = np.asarray(dst["block_0"]["attn"][name])
+        np.testing.assert_array_equal(d[1], s[6])
+        np.testing.assert_array_equal(d[5], s[2])
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache tree: match / insert / evict
+# ---------------------------------------------------------------------------
+
+
+def _cache(num_pages=32, ps=4):
+    pool = PagePool(num_pages)
+    return PrefixCache(pool, ps), pool
+
+
+def test_tree_match_full_and_partial_chunks():
+    cache, pool = _cache()
+    prompt = list(range(100, 110))  # 2 full pages + 2-token tail at ps=4
+    pages = pool.alloc("seed", 3)
+    assert cache.insert(prompt, pages) == 3
+    assert cache.cached_pages == 3
+    pool.free("seed")  # cache pins survive the publisher retiring
+    assert all(pool.refcount(p) == 1 for p in pages)
+
+    # Exact re-match, clamped so the last prompt token stays prefillable.
+    m = cache.match(prompt, max_tokens=len(prompt) - 1)
+    assert m.pages == pages and m.tokens == 9
+    # Divergent tail: full chunks match, partial matches its common prefix.
+    m2 = cache.match(prompt[:9] + [999, 999], max_tokens=10)
+    assert m2.pages == pages and m2.tokens == 9
+    # Divergence inside the first chunk: no usable full node, no partial.
+    m3 = cache.match([999] + prompt[1:], max_tokens=9)
+    assert m3.pages == [] and m3.tokens == 0
+    # max_tokens <= 0 (single-token prompt) can never hit.
+    assert cache.match(prompt, max_tokens=0).pages == []
+
+
+def test_tree_insert_dedupes_shared_chunks():
+    cache, pool = _cache()
+    a = pool.alloc("a", 3)
+    cache.insert([1, 2, 3, 4, 5, 6, 7, 8, 9], a)
+    b = pool.alloc("b", 3)
+    # Same first two chunks, different tail: only the tail node is new and
+    # b's duplicate pages stay private (un-pinned by the cache).
+    assert cache.insert([1, 2, 3, 4, 5, 6, 7, 8, 42], b) == 1
+    assert cache.cached_pages == 4
+    assert pool.refcount(b[0]) == 1 and pool.refcount(b[2]) == 2
+
+
+def test_tree_evicts_lru_unreferenced_leaves_only():
+    cache, pool = _cache()
+    a = pool.alloc("a", 2)      # chunk X + tail (touched first -> oldest)
+    cache.insert([1, 2, 3, 4, 5, 6], a)
+    b = pool.alloc("b", 2)      # chunk Y + tail (younger)
+    cache.insert([9, 9, 9, 9, 7, 7], b)
+    pool.free("a")
+    pool.free("b")
+    m = cache.match([1, 2, 3, 4, 5, 6], max_tokens=5)
+    cache.acquire(m, "reader")  # pins a's nodes
+
+    assert cache.evict(10) == 2  # only b's tail leaf + then b's chunk go
+    assert cache.cached_pages == 2
+    assert pool.refcount(b[0]) == 0 and pool.refcount(a[0]) > 0
+    # Release the pin: a's subtree becomes evictable, tail leaf first.
+    cache.release(m.nodes)
+    pool.free("reader")
+    assert cache.evict(10) == 2
+    assert cache.cached_pages == 0 and pool.num_free == pool.num_pages - 1
+    with pytest.raises(ValueError, match="underflow"):
+        cache.release(m.nodes)
+
+
+def test_tree_eviction_order_is_lru():
+    cache, pool = _cache()
+    old = pool.alloc("old", 1)
+    cache.insert([1, 2, 3, 4], old)
+    young = pool.alloc("young", 1)
+    cache.insert([5, 6, 7, 8], young)
+    pool.free("old")
+    pool.free("young")
+    # Touch the old node via a match+acquire/release cycle -> now youngest.
+    m = cache.match([1, 2, 3, 4], max_tokens=3)
+    cache.acquire(m, "toucher")
+    cache.release(m.nodes)
+    pool.free("toucher")
+    cache.evict(1)
+    assert cache.match([5, 6, 7, 8], max_tokens=3).pages == []
+    assert cache.match([1, 2, 3, 4], max_tokens=3).pages == old
+
+
+# ---------------------------------------------------------------------------
+# engine: cached == uncached greedy tokens, COW divergence, LRU pressure
+# ---------------------------------------------------------------------------
+
+
+def _tiny(seq_len=128):
+    bundle = registry.create_model("llama_tiny", seq_len=seq_len,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                         train=False)["params"]
+    return module, params
+
+
+def _run_staggered(eng, reqs):
+    """Submit sequentially, draining between submissions, so later requests
+    actually see the pages earlier ones published."""
+    done = []
+    for r in reqs:
+        eng.submit(r)
+        done += eng.run()
+    return {r.request_id: r for r in done}
+
+
+def test_cached_tokens_identical_incl_cow(devices):
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=64, page_size=8)
+
+    rng = np.random.default_rng(21)
+    shared = rng.integers(1, 512, 16).tolist()  # two full pages
+    reqs = []
+    # Page-boundary prompt lengths: 16 (exact), 17 (1-token tail), 24
+    # (boundary again), plus a mid-page divergence that forces COW of a
+    # shared partial page.
+    for i, tail_len in enumerate([0, 1, 8, 3]):
+        tail = rng.integers(1, 512, tail_len).tolist()
+        reqs.append(engine_lib.Request(
+            request_id=f"c{i}", prompt=shared + tail, max_new_tokens=6))
+    reqs.append(engine_lib.Request(  # exact duplicate of c0: full-prompt hit
+        request_id="dup", prompt=list(reqs[0].prompt), max_new_tokens=6))
+
+    cold = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1, 2), prompt_buckets=(16, 32),
+        max_model_len=48)
+    ref = {r.request_id: r.generated
+           for r in _run_staggered(
+               cold, [engine_lib.Request(r.request_id, list(r.prompt),
+                                         r.max_new_tokens)
+                      for r in reqs]).values()}
+
+    warm = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1, 2), prompt_buckets=(16, 32),
+        max_model_len=48, prefix_cache=True)
+    n = warm.warmup()
+    done = _run_staggered(warm, reqs)
+    assert len(done) == 5
+    for rid, toks in ref.items():
+        assert done[rid].generated == toks, rid
+    assert warm.stats["cached_tokens"] > 0
+    assert warm.stats["cow_copies"] > 0  # the divergent tails exercised COW
+    assert warm.prefix_hit_rate() > 0.3
+    assert warm.stats["compiles"] == n  # splicing never minted a new shape
+
+
+def test_cache_eviction_under_pressure_keeps_tokens(devices):
+    module, params = _tiny()
+    # 11 usable pages of 8 tokens; each 17-token prompt takes 3 pages and
+    # the cache pins them after retire -> the fourth admission must evict.
+    spec = engine_lib.spec_for_module(module, num_pages=12, page_size=8)
+    eng = engine_lib.ContinuousBatchingEngine(
+        module, params, spec, decode_buckets=(1,), prompt_buckets=(32,),
+        max_model_len=32, prefix_cache=True)
+    rng = np.random.default_rng(5)
+    reqs = [engine_lib.Request(request_id=f"p{i}",
+                               prompt=rng.integers(1, 512, 17).tolist(),
+                               max_new_tokens=4)
+            for i in range(4)]
+    done = _run_staggered(eng, reqs)
+    assert len(done) == 4
+    assert eng.prefix_cache.stats["evicted_pages"] > 0
+    for r in reqs:
+        # Every request decoded correctly despite cache pages being
+        # reclaimed out from under the tree.
+        logits = module.apply({"params": params},
+                              jnp.asarray([list(r.prompt)], jnp.int32),
+                              train=False)
+        first = int(jnp.argmax(logits[0, len(r.prompt) - 1]))
+        assert done[r.request_id].generated[0] == first, r.request_id

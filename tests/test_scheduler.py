@@ -83,10 +83,23 @@ def test_load_jobs_parses_and_validates(tmp_path):
         {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "after": "a"}]},
         {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"],
                               "after_event": "vibes"}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "kind": "batch"}]},
     ):
         path.write_text(json.dumps(bad))
         with pytest.raises(ValueError):
             scheduler_lib.load_jobs(str(path))
+
+
+def test_load_jobs_parses_serve_kind(tmp_path):
+    path = tmp_path / "jobs.json"
+    path.write_text(json.dumps({"pool": 2, "jobs": [
+        {"name": "train", "cmd": ["main.py"]},
+        {"name": "api", "cmd": ["main.py", "--serve"], "kind": "serve"},
+    ]}))
+    _, specs = scheduler_lib.load_jobs(str(path))
+    assert [s.kind for s in specs] == ["train", "serve"]
+    sched = scheduler_lib.FleetScheduler(2, specs)
+    assert sched.gauges()["fleet_jobs_serve"] == 1
 
 
 # ---------------------------------------------------------------------------

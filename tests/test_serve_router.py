@@ -1,0 +1,239 @@
+"""Disaggregation + router: KV handoff identity, chunked prefill, affinity
+placement, drain/kill drills.
+
+Two claims carry the PR: (1) splitting prefill and decode into separate
+engines with an explicit KV-page handoff changes WHERE tokens are computed
+but never WHICH tokens come out; (2) the router can lose a replica
+mid-stream (graceful drain or hard kill) and still complete every request
+with the same greedy tokens, because re-routed requests recompute from
+their prompts deterministically.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.serve import (
+    engine as engine_lib, router as router_lib)
+from pytorch_distributed_training_example_tpu.serve.router import (
+    PrefixAffinityRouter, chunk_keys)
+
+
+def _tiny(seq_len=128):
+    bundle = registry.create_model("llama_tiny", seq_len=seq_len,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                         train=False)["params"]
+    return module, params
+
+
+def _reference_greedy(module, params, prompt, steps):
+    toks = list(prompt)
+    out = []
+    for _ in range(steps):
+        logits = module.apply({"params": params},
+                              jnp.asarray([toks], jnp.int32), train=False)
+        out.append(int(jnp.argmax(logits[0, len(toks) - 1])))
+        toks.append(out[-1])
+    return out
+
+
+def _engine(module, params, spec, **kw):
+    kw.setdefault("decode_buckets", (1, 2))
+    kw.setdefault("prompt_buckets", (16, 32))
+    kw.setdefault("max_model_len", 48)
+    return engine_lib.ContinuousBatchingEngine(module, params, spec, **kw)
+
+
+# ---------------------------------------------------------------------------
+# chunk_keys: process-stable hashing
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_keys_stable_and_prefix_consistent():
+    a = chunk_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    assert len(a) == 2  # one key per FULL chunk; the 1-token tail has none
+    assert a == chunk_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    # Shared prefix -> shared key chain prefix; divergence changes the rest.
+    b = chunk_keys([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    assert b[0] == a[0] and b[1] != a[1]
+    assert chunk_keys([1, 2, 3], 4) == []
+
+
+# ---------------------------------------------------------------------------
+# disaggregation: handoff identity + compile flatness, chunked prefill
+# ---------------------------------------------------------------------------
+
+
+def test_disaggregated_tokens_match_unified(devices):
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=64, page_size=8)
+    pair = engine_lib.DisaggregatedServe(
+        _engine(module, params, spec, role="prefill"),
+        _engine(module, params, spec, role="decode"))
+    n = pair.warmup()
+    rng = np.random.default_rng(31)
+    reqs = [engine_lib.Request(request_id=f"d{i}",
+                               prompt=rng.integers(1, 512, plen).tolist(),
+                               max_new_tokens=8)
+            for i, plen in enumerate([5, 8, 17, 24])]
+    for r in reqs:
+        pair.submit(r)
+    done = {r.request_id: r for r in pair.run()}
+    assert len(done) == 4
+    assert pair.stats["handoffs_out"] == 4 == pair.stats["handoffs_in"]
+    for r in reqs:
+        ref = _reference_greedy(module, params, r.prompt, r.max_new_tokens)
+        assert done[r.request_id].generated == ref, r.request_id
+    # Both roles stayed inside their warmed executables.
+    assert pair.stats["compiles"] == n
+
+
+def test_chunked_prefill_matches_whole_prompt(devices):
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=64, page_size=8)
+    eng = _engine(module, params, spec, prefill_chunk=16)
+    n = eng.warmup()
+    rng = np.random.default_rng(33)
+    # Longer than one chunk -> prefilled in 16-token windows through the
+    # history-attention program; shorter -> single window, plain prefill.
+    reqs = [engine_lib.Request(request_id=f"w{i}",
+                               prompt=rng.integers(1, 512, plen).tolist(),
+                               max_new_tokens=6)
+            for i, plen in enumerate([31, 12, 17])]
+    for r in reqs:
+        eng.submit(r)
+    done = {r.request_id: r for r in eng.run()}
+    assert len(done) == 3
+    for r in reqs:
+        ref = _reference_greedy(module, params, r.prompt, r.max_new_tokens)
+        assert done[r.request_id].generated == ref, r.request_id
+    assert eng.stats["compiles"] == n
+
+
+def test_disaggregate_rejects_mismatched_pair(devices):
+    module, params = _tiny()
+    spec = engine_lib.spec_for_module(module, num_pages=64, page_size=8)
+    with pytest.raises(ValueError):
+        engine_lib.DisaggregatedServe(
+            _engine(module, params, spec, role="decode"),
+            _engine(module, params, spec, role="prefill"))
+    decode_only = _engine(module, params, spec, role="decode")
+    with pytest.raises(ValueError):
+        decode_only.submit(engine_lib.Request("x", [1, 2, 3], 4))
+
+
+# ---------------------------------------------------------------------------
+# router: affinity placement, least-loaded fallback, drain/kill drills
+# ---------------------------------------------------------------------------
+
+
+def _router(module, params, n=2, policy="affinity", **ekw):
+    spec = engine_lib.spec_for_module(module, num_pages=64, page_size=8)
+    replicas = {f"replica{i}": _engine(module, params, spec,
+                                       prefix_cache=True, **ekw)
+                for i in range(n)}
+    for rep in replicas.values():
+        rep.warmup()
+    return PrefixAffinityRouter(replicas, page_size=8, policy=policy)
+
+
+def _shared_prefix_reqs(rng, shared, count, tail=6, new=5, tag="r"):
+    return [engine_lib.Request(
+        request_id=f"{tag}{i}",
+        prompt=list(shared) + rng.integers(1, 512, tail).tolist(),
+        max_new_tokens=new) for i in range(count)]
+
+
+def test_affinity_routes_shared_prefix_to_one_replica(devices):
+    module, params = _tiny()
+    router = _router(module, params)
+    rng = np.random.default_rng(41)
+    groups = [rng.integers(1, 512, 16).tolist() for _ in range(2)]
+    placements = {0: set(), 1: set()}
+    for i in range(6):
+        g = i % 2
+        r = _shared_prefix_reqs(rng, groups[g], 1, tag=f"g{g}_{i}")[0]
+        router.submit(r)
+        placements[g].add(router._placed[r.request_id])
+        router.run()
+    # Every request in a group landed on the group's first-placement owner.
+    assert len(placements[0]) == 1 and len(placements[1]) == 1
+    assert router.stats["affinity_hits"] >= 4
+    fleet = router.fleet_stats()
+    assert sum(rep["completed"] for rep in fleet["replicas"].values()) == 6
+    # The shared prefixes actually hit the owning replica's cache.
+    hits = sum(rep["stats"]["cached_tokens"]
+               for rep in fleet["replicas"].values())
+    assert hits > 0
+
+
+def test_least_loaded_policy_spreads_saturation(devices):
+    module, params = _tiny()
+    router = _router(module, params, policy="least_loaded")
+    rng = np.random.default_rng(43)
+    shared = rng.integers(1, 512, 16).tolist()
+    for r in _shared_prefix_reqs(rng, shared, 4):
+        router.submit(r)
+    done = router.run()
+    assert len(done) == 4
+    fleet = router.fleet_stats()
+    loads = [rep["completed"] for rep in fleet["replicas"].values()]
+    assert loads == [2, 2]  # identical prompts would pile up under affinity
+    assert router.stats["affinity_hits"] == 0
+
+
+def test_drain_finishes_actives_and_reroutes_waiting(devices):
+    module, params = _tiny()
+    router = _router(module, params)
+    rng = np.random.default_rng(47)
+    shared = rng.integers(1, 512, 16).tolist()
+    reqs = _shared_prefix_reqs(rng, shared, 5, new=8)
+    ref = {r.request_id:
+           _reference_greedy(module, params, r.prompt, r.max_new_tokens)
+           for r in reqs}
+    for r in reqs:
+        router.submit(r)
+    victim = router._placed[reqs[0].request_id]
+    for _ in range(2):
+        router.step()
+    moved = router.drain(victim)
+    assert router._replicas[victim].draining
+    done = {r.request_id: r for r in router.run()}
+    # Zero drops, token identity for both the drained replica's in-flight
+    # work and everything re-routed to the survivor.
+    assert len(done) == 5 and router.stats["drained"] == 1
+    assert router.stats["rerouted"] == moved
+    for rid, toks in ref.items():
+        assert done[rid].generated == toks, rid
+    assert router._replicas[victim].engine.num_active == 0
+
+
+def test_kill_reroutes_everything_with_identical_tokens(devices):
+    module, params = _tiny()
+    router = _router(module, params)
+    rng = np.random.default_rng(53)
+    shared = rng.integers(1, 512, 16).tolist()
+    reqs = _shared_prefix_reqs(rng, shared, 5, new=8)
+    ref = {r.request_id:
+           _reference_greedy(module, params, r.prompt, r.max_new_tokens)
+           for r in reqs}
+    for r in reqs:
+        router.submit(r)
+    victim = router._placed[reqs[0].request_id]
+    for _ in range(3):
+        router.step()  # some requests are mid-decode on the victim
+    lost = router.kill(victim)
+    assert lost > 0 and router.stats["killed"] == 1
+    done = {r.request_id: r for r in router.run()}
+    assert len(done) == 5  # zero drops
+    for rid, toks in ref.items():
+        # Greedy recompute from the prompt is deterministic, so even
+        # requests killed mid-generation produce identical streams.
+        assert done[rid].generated == toks, rid
+    survivors = [n for n in router._replicas if n != victim]
+    assert all(router._replicas[n].alive for n in survivors)
