@@ -15,9 +15,17 @@ TPU pod, so this doubles as the end-to-end CI leg. Two measured phases:
 ``--aot`` emits the chipless byte/FLOP model of the decode step instead:
 the same ``jit(...).lower(abstract).compile()`` front-end as
 profile_step.py, with per-region HBM bytes attributed by the serve_*
-named-scope tags (serve_cache / serve_attn / serve_mlp / serve_head) and
-gated in CI by ``check_regression.py --aot-bytes`` against the
-``aot_regions`` golden (key ``<model>_decode b<bucket> s<max_len> -``).
+named-scope tags (serve_cache / serve_attn / serve_mlp / serve_moe /
+serve_head) and gated in CI by ``check_regression.py --aot-bytes``
+against the ``aot_regions`` golden (key
+``<model>_decode b<bucket> s<max_len> -``).
+
+``--spec-decode ngram|draft`` (r19) runs saturation a second time with
+speculative decoding ON over the same seeded stream, asserts greedy
+token identity request-by-request, and reports the acceptance rate,
+accepted-length histogram, and a modeled tokens/sec multiplier: mean
+tokens emitted per verify step times the decode/verify byte ratio from
+the AOT census (verify golden key ``<model>_verify b<bucket> s<K+1> -``).
 
 Human-readable progress goes to stderr; the result JSON to stdout
 (pipeable into check_regression.py, like bench.py).
@@ -40,7 +48,7 @@ if REPO_ROOT not in sys.path:
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 #: Named-scope tags the decode forward emits (models/llama.py decode path).
-SERVE_TAG_RE = re.compile(r"\bserve_(embed|cache|attn|mlp|head)\b")
+SERVE_TAG_RE = re.compile(r"\bserve_(embed|cache|attn|mlp|moe|head)\b")
 
 
 def _say(msg: str) -> None:
@@ -88,8 +96,34 @@ def latency_summary(done, wall_s: float, num_chips: int) -> dict:
     }
 
 
+def _make_proposer(args):
+    """Fresh proposer per engine — draft proposers own a paged cache pool,
+    so replicas must not share one. "ngram" is resolved by the engine;
+    "draft" builds the registry model named by --draft-model (default: the
+    target model itself with the same init seed — the self-draft acceptance
+    ceiling, useful for exercising the full verify/rollback path)."""
+    if args.spec_decode == "ngram":
+        return "ngram"
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve import spec_decode
+
+    dtype = jnp.float32 if args.precision == "fp32" else jnp.bfloat16
+    name = args.draft_model or args.model
+    bundle = registry.create_model(name, seq_len=args.max_model_len,
+                                   dtype=dtype, param_dtype=dtype)
+    dparams = bundle.module.init(jax.random.PRNGKey(args.seed),
+                                 jnp.zeros((1, 8), jnp.int32),
+                                 train=False)["params"]
+    return spec_decode.DraftModelProposer(bundle.module, dparams,
+                                          draft_len=args.draft_len)
+
+
 def _build_engine(module, params, spec, args, *, closed_loop: bool,
-                  cached: bool, telemetry=None, metrics=None):
+                  cached: bool, spec_on: bool = False, telemetry=None,
+                  metrics=None):
     from pytorch_distributed_training_example_tpu.serve import engine as engine_lib
 
     kw = dict(decode_buckets=(1,) if closed_loop else args.decode_buckets,
@@ -98,12 +132,15 @@ def _build_engine(module, params, spec, args, *, closed_loop: bool,
               metrics=metrics)
     mk = lambda **extra: engine_lib.ContinuousBatchingEngine(
         module, params, spec, **kw, **extra)
+    spec_kw = (dict(spec_decode=_make_proposer(args),
+                    draft_len=args.draft_len) if spec_on else {})
     if args.disaggregate:
         return engine_lib.DisaggregatedServe(
             mk(role="prefill", prefix_cache=cached,
                prefill_chunk=args.prefill_chunk),
-            mk(role="decode"))
-    return mk(prefix_cache=cached, prefill_chunk=args.prefill_chunk)
+            mk(role="decode", **spec_kw))
+    return mk(prefix_cache=cached, prefill_chunk=args.prefill_chunk,
+              **spec_kw)
 
 
 def _parse_chaos(text: str | None) -> tuple[str, int] | None:
@@ -120,8 +157,8 @@ def _parse_chaos(text: str | None) -> tuple[str, int] | None:
 
 
 def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
-              cached: bool = False, telemetry=None, metrics=None
-              ) -> tuple[dict, list]:
+              cached: bool = False, spec_on: bool = False, telemetry=None,
+              metrics=None) -> tuple[dict, list]:
     """One measured phase; returns (summary dict, completed Requests)."""
     from pytorch_distributed_training_example_tpu.serve import loadgen
 
@@ -134,7 +171,8 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
 
         fleet = {f"replica{i}": _build_engine(
                      module, params, spec, args, closed_loop=closed_loop,
-                     cached=cached, telemetry=telemetry, metrics=metrics)
+                     cached=cached, spec_on=spec_on, telemetry=telemetry,
+                     metrics=metrics)
                  for i in range(replicas)}
         n_exec = sum(rep.warmup() for rep in fleet.values())
         eng = router_lib.PrefixAffinityRouter(
@@ -142,7 +180,8 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
     else:
         eng = _build_engine(module, params, spec, args,
                             closed_loop=closed_loop, cached=cached,
-                            telemetry=telemetry, metrics=metrics)
+                            spec_on=spec_on, telemetry=telemetry,
+                            metrics=metrics)
         n_exec = eng.warmup()
     chaos_fired = False
     t0 = time.perf_counter()
@@ -193,6 +232,18 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
             "cached_tokens": stats["cached_tokens"],
             "prompt_tokens": stats["prompt_tokens"],
             "cow_copies": stats["cow_copies"],
+        }
+    if spec_on:
+        drafted = stats.get("draft_tokens", 0)
+        out["spec"] = {
+            "spec_steps": stats.get("spec_steps", 0),
+            "draft_tokens": drafted,
+            "accepted_tokens": stats.get("accepted_tokens", 0),
+            "accept_rate": round(stats.get("accepted_tokens", 0)
+                                 / max(drafted, 1), 4),
+            "accepted_len_hist": {
+                str(n): stats.get(f"spec_accept_{n}", 0)
+                for n in range(args.draft_len + 1)},
         }
     if args.disaggregate:
         out["handoffs"] = stats.get("handoffs_out", 0)
@@ -386,6 +437,83 @@ def aot_prefill_report(model_name: str, *, prompt_bucket: int, page_size: int,
     }
 
 
+def aot_verify_report(model_name: str, *, batch: int, width: int,
+                      page_size: int, num_pages: int, max_model_len: int,
+                      precision: str = "fp32") -> dict:
+    """Chipless AOT byte model of ONE speculative VERIFY step.
+
+    The verify program is the engine's multi-token history-attention
+    forward with ``all_logits`` — it scores all ``width = draft_len + 1``
+    positions in one pass and returns the per-position argmax stacked with
+    the echoed input tokens (the engine's one-fetch acceptance contract).
+    Lowered here exactly as ``_get_step("verify", batch, width)`` lowers
+    it, so the byte census is the program serving actually runs. CI gates
+    it through the same ``check_regression.py --aot-bytes`` golden as the
+    decode rows (key ``<model>_verify b<batch> s<width> -``); the spec
+    summary divides decode bytes by verify bytes to model the tokens/sec
+    multiplier (verify reads the weights once for up to ``width`` emitted
+    tokens — that amortization IS the speedup)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+        pages_for_tokens)
+
+    dtype = jnp.float32 if precision == "fp32" else jnp.bfloat16
+    bundle = registry.create_model(model_name, seq_len=max_model_len,
+                                   dtype=dtype, param_dtype=dtype)
+    module = bundle.module
+    table_width = pages_for_tokens(max_model_len, page_size)
+    sds = jax.ShapeDtypeStruct
+    tok = sds((batch, width), jnp.int32)
+    pos = sds((batch, width), jnp.int32)
+    table = sds((batch, table_width), jnp.int32)
+    last = sds((batch,), jnp.int32)
+
+    def ctx(positions, page_table, last_index):
+        return dict(positions=positions, page_table=page_table,
+                    cache_spec=(num_pages, page_size),
+                    last_index=last_index, history=True, all_logits=True,
+                    attn_impl="auto")
+
+    def init_fn(rng, tokens, positions, page_table, last_index):
+        return module.init(rng, tokens, train=False,
+                           decode_ctx=ctx(positions, page_table, last_index))
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0), tok, pos, table,
+                            last)
+    params_abs, cache_abs = shapes["params"], shapes["cache"]
+
+    def run(params, cache, tokens, positions, page_table, last_index):
+        logits, vs = module.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            decode_ctx=ctx(positions, page_table, last_index),
+            mutable=["cache"])
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.stack([out, tokens.astype(jnp.int32)], axis=1), \
+            vs["cache"]
+
+    compiled = jax.jit(run, donate_argnums=1).lower(
+        params_abs, cache_abs, tok, pos, table, last).compile()
+    regions, ca = _tabulate_regions(compiled)
+    return {
+        "mode": "aot_hlo_model",
+        "attribution": "proportional_bytes",
+        "backend_lowering": jax.default_backend(),
+        "model": f"{model_name}_verify",
+        "per_chip_batch": batch,
+        "seq_len": width,               # verify window: draft_len + 1
+        "max_model_len": max_model_len,
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "precision": precision,
+        "xla_flops_per_step": ca.get("flops"),
+        "xla_bytes_accessed": ca.get("bytes accessed"),
+        "regions": regions,
+    }
+
+
 def _report_gbytes(report: dict) -> float:
     return sum(r["gbytes_modeled"] for r in report["regions"].values())
 
@@ -424,6 +552,19 @@ def main(argv=None):
                         "prefix cache ON), verify token identity, report "
                         "hit rate + TTFT/ITL deltas + modeled "
                         "prefill-bytes-avoided")
+    p.add_argument("--spec-decode", default="off",
+                   choices=("off", "ngram", "draft"),
+                   help="run saturation again with speculative decoding ON "
+                        "(same seeded stream), assert greedy token "
+                        "identity, report acceptance rate + accepted-length "
+                        "histogram + modeled tokens/s multiplier from the "
+                        "AOT byte census")
+    p.add_argument("--draft-len", type=int, default=4,
+                   help="speculation window: tokens drafted per slot-step")
+    p.add_argument("--draft-model", default=None,
+                   help="with --spec-decode draft: registry model name for "
+                        "the draft proposer (default: the target model "
+                        "itself — self-draft acceptance ceiling)")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="chunked-prefill window (tokens, multiple of the "
                         "page size); 0 = whole prompt")
@@ -452,6 +593,10 @@ def main(argv=None):
                    help="with --aot: single batch-1 PREFILL report at this "
                         "prompt bucket on stdout (pipe into "
                         "check_regression.py --aot-bytes)")
+    p.add_argument("--aot-verify-bucket", type=int, default=None,
+                   help="with --aot: single speculative VERIFY report at "
+                        "this decode bucket (width --draft-len + 1) on "
+                        "stdout (pipe into check_regression.py --aot-bytes)")
     p.add_argument("--json", default=None, help="also write result JSON here")
     args = p.parse_args(argv)
 
@@ -463,6 +608,15 @@ def main(argv=None):
                     "seed": args.seed}
 
     if args.aot:
+        if args.aot_verify_bucket:
+            _say(f"serve_bench: AOT verify model, bucket "
+                 f"{args.aot_verify_bucket}, width {args.draft_len + 1}")
+            print(json.dumps(aot_verify_report(
+                args.model, batch=args.aot_verify_bucket,
+                width=args.draft_len + 1, page_size=args.page_size,
+                num_pages=args.num_pages, max_model_len=args.max_model_len,
+                precision=args.precision), indent=2))
+            return 0
         if args.aot_prefill_bucket:
             _say(f"serve_bench: AOT prefill model, "
                  f"bucket {args.aot_prefill_bucket}")
@@ -490,6 +644,15 @@ def main(argv=None):
                 args.model, prompt_bucket=sp, page_size=args.page_size,
                 num_pages=args.num_pages, max_model_len=args.max_model_len,
                 precision=args.precision))
+        if args.spec_decode != "off":
+            for b in buckets:
+                _say(f"serve_bench: AOT verify model, bucket {b}, "
+                     f"width {args.draft_len + 1}")
+                reports.append(aot_verify_report(
+                    args.model, batch=b, width=args.draft_len + 1,
+                    page_size=args.page_size, num_pages=args.num_pages,
+                    max_model_len=args.max_model_len,
+                    precision=args.precision))
         result["aot"] = reports
         print(json.dumps(result, indent=2))
         if args.json:
@@ -582,6 +745,53 @@ def main(argv=None):
              f"ttft p50 delta {result['prefix_cache']['ttft_ms_delta']['p50']}"
              f" ms, modeled prefill GB avoided "
              f"{result['prefix_cache']['prefill_gbytes_avoided_modeled']}")
+    if args.spec_decode != "off":
+        _say(f"serve_bench: phase saturation_spec ({args.spec_decode}, "
+             f"draft_len={args.draft_len}, same seeded stream)")
+        result["saturation_spec"], spec_done = run_phase(
+            module, params, spec, args, mkload(args.rate, args.requests,
+                                               args.seed),
+            closed_loop=False, spec_on=True, telemetry=recorder,
+            metrics=metrics)
+        ssat = result["saturation_spec"]
+        base_by_id = {r.request_id: r.generated for r in base_done}
+        for r in spec_done:
+            assert r.generated == base_by_id[r.request_id], \
+                f"spec token identity broken for {r.request_id}"
+        # Modeled multiplier: the unsped engine pays one decode step's
+        # bytes per emitted token; the sped one pays one verify step's
+        # bytes per (mean accepted + 1 bonus) tokens. Draft cost is not
+        # in the ratio — zero device work for ngram, and the draft
+        # model's census is the plain decode row of --draft-model.
+        bucket = max(args.decode_buckets)
+        verify_report = aot_verify_report(
+            args.model, batch=bucket, width=args.draft_len + 1,
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_model_len=args.max_model_len, precision=args.precision)
+        decode_report = aot_decode_report(
+            args.model, batch=bucket, page_size=args.page_size,
+            num_pages=args.num_pages, max_model_len=args.max_model_len,
+            precision=args.precision)
+        hist = ssat["spec"]["accepted_len_hist"]
+        slot_steps = sum(hist.values())
+        mean_emitted = (ssat["spec"]["accepted_tokens"] + slot_steps) \
+            / max(slot_steps, 1)
+        vg = _report_gbytes(verify_report)
+        dg = _report_gbytes(decode_report)
+        result["spec_decode"] = {
+            **ssat["spec"],
+            "token_identity": "ok",
+            "mean_emitted_per_verify": round(mean_emitted, 4),
+            "decode_step_gbytes_modeled": round(dg, 4),
+            "verify_step_gbytes_modeled": round(vg, 4),
+            "modeled_tokens_per_s_multiplier": round(
+                mean_emitted * dg / max(vg, 1e-12), 4),
+        }
+        _say(f"  spec decode: accept rate "
+             f"{result['spec_decode']['accept_rate']}, mean emitted/verify "
+             f"{result['spec_decode']['mean_emitted_per_verify']}, modeled "
+             f"tok/s multiplier "
+             f"{result['spec_decode']['modeled_tokens_per_s_multiplier']}")
     result["goodput"] = {k: recorder.goodput()[k]
                          for k in ("goodput_fraction", "coverage", "wall_s",
                                    "categories_s")}

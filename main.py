@@ -261,6 +261,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Zipf exponent for template popularity")
     p.add_argument("--serve-prefix-len", default=None, dest="serve_prefix_len",
                    help="template length range, \"min:max\" tokens")
+    p.add_argument("--serve-spec-decode", default=None,
+                   dest="serve_spec_decode",
+                   choices=["off", "ngram", "draft"],
+                   help="speculative decoding proposer: self-drafting n-gram "
+                        "lookup or a separate draft model "
+                        "(serve/spec_decode.py; greedy output stays "
+                        "bit-identical to the unsped engine)")
+    p.add_argument("--serve-draft-len", type=int, default=None,
+                   dest="serve_draft_len",
+                   help="max draft tokens verified per step (default 4)")
+    p.add_argument("--serve-draft-model", default=None,
+                   dest="serve_draft_model",
+                   help="draft model name for --serve-spec-decode draft, "
+                        "optionally \"name@ckpt_dir\" to restore its params")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,

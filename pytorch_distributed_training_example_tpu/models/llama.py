@@ -181,23 +181,31 @@ class LlamaBlock(nn.Module):
         h = rn("mlp_norm")(x)
         d = x.shape[-1]
         if self.num_experts > 0:
-            if decode_ctx is not None:
-                raise NotImplementedError(
-                    "the serving decode path does not support MoE blocks yet "
-                    "(ROADMAP: serving follow-ups)")
             from pytorch_distributed_training_example_tpu.parallel.moe import MoEBlock
 
-            h = MoEBlock(self.num_experts, self.ffn_dim,
-                         top_k=self.moe_top_k,
-                         capacity_factor=self.moe_capacity_factor,
-                         dispatch_impl=self.moe_dispatch_impl,
-                         combine_dtype=self.moe_combine_dtype,
-                         router_dtype=self.moe_router_dtype,
-                         router_impl=self.moe_router_impl,
-                         ep_dispatch=self.moe_ep_dispatch,
-                         ep_overlap_chunks=self.moe_ep_overlap_chunks,
-                         dtype=self.dtype,
-                         param_dtype=self.param_dtype, name="moe")(h, train)
+            # Serving decode reuses the training MoE block at batch-decode
+            # shapes (T = B*S tokens). ``decode=True`` forces the dropless
+            # route: capacity-dropped dispatch is non-causal (a token's k>1
+            # choice competes for capacity with LATER tokens' k=0 choices),
+            # so only per-token-independent dropless routing has an exact
+            # incremental equivalent. Params are identical across dispatch
+            # impls, so any trained checkpoint serves through this path.
+            scope = (jax.named_scope("serve_moe") if decode_ctx is not None
+                     else contextlib.nullcontext())
+            with scope:
+                h = MoEBlock(self.num_experts, self.ffn_dim,
+                             top_k=self.moe_top_k,
+                             capacity_factor=self.moe_capacity_factor,
+                             dispatch_impl=self.moe_dispatch_impl,
+                             combine_dtype=self.moe_combine_dtype,
+                             router_dtype=self.moe_router_dtype,
+                             router_impl=self.moe_router_impl,
+                             ep_dispatch=self.moe_ep_dispatch,
+                             ep_overlap_chunks=self.moe_ep_overlap_chunks,
+                             dtype=self.dtype,
+                             param_dtype=self.param_dtype,
+                             name="moe")(h, train,
+                                         decode=decode_ctx is not None)
         else:
             scope = (jax.named_scope("serve_mlp") if decode_ctx is not None
                      else contextlib.nullcontext())
@@ -272,14 +280,11 @@ class Llama(nn.Module):
         """``decode_ctx`` switches to the serving forward (serve/engine.py):
         a dict with ``positions`` [B,S], ``page_table`` [B,max_pages],
         ``cache_spec`` (num_pages, page_size), ``last_index`` [B] and
-        optionally ``attn_impl``. K/V live in the flax ``cache`` collection
-        (paged pools); the return value is next-token logits [B, vocab]
-        taken at ``last_index`` instead of the full [B, S, vocab]."""
-        if decode_ctx is not None and self.scan_layers:
-            raise NotImplementedError(
-                "the serving decode path requires unscanned blocks "
-                "(scan_layers=False): the paged cache pools are per-block "
-                "variables, not a stacked carry")
+        optionally ``attn_impl`` / ``history`` / ``all_logits``. K/V live
+        in the flax ``cache`` collection (paged pools); the return value is
+        next-token logits [B, vocab] taken at ``last_index`` — or the full
+        [B, S, vocab] when ``all_logits`` is set (the speculative-decode
+        verify step scores every draft position in one forward)."""
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
         x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
@@ -310,15 +315,24 @@ class Llama(nn.Module):
             # One stacked block scanned over a leading 'layers' dim: constant
             # trace/compile cost regardless of depth. The body wrapper adapts
             # LlamaBlock's single-array return to scan's (carry, ys) contract.
+            # Under ``decode_ctx`` the per-block paged K/V pools become a
+            # STACKED carry too: scanning the ``cache`` collection on axis 0
+            # gives [L, P, page_size, Hkv, D] pools, so scanned checkpoints
+            # serve without a retrain (serve/kv_cache.py rank-dispatches its
+            # page ops on the extra leading dim).
             inner = block_cls
 
             class _ScanBody(nn.Module):
                 @nn.compact
                 def __call__(self, carry, _):
-                    return inner(name="block", **block_args)(carry, train), None
+                    return inner(name="block", **block_args)(
+                        carry, train, decode_ctx), None
 
+            variable_axes = {"params": 0}
+            if decode_ctx is not None:
+                variable_axes["cache"] = 0
             ScanBlocks = nn.scan(
-                _ScanBody, variable_axes={"params": 0},
+                _ScanBody, variable_axes=variable_axes,
                 split_rngs={"params": True, "dropout": True},
                 length=self.num_layers)
             x, _ = ScanBlocks(name="blocks")(x, None)
@@ -330,17 +344,40 @@ class Llama(nn.Module):
             # Serving: only the last real position's logits matter (the
             # next-token distribution). Gather the hidden row BEFORE the
             # [d, vocab] head matmul — at decode S == 1 this is free, at
-            # prefill it turns a [B,S,V] matmul into [B,V].
+            # prefill it turns a [B,S,V] matmul into [B,V]. The speculative
+            # verify step instead needs EVERY position's next-token
+            # distribution (one score per draft token plus the bonus), so
+            # ``decode_ctx["all_logits"]`` (static — its own compiled
+            # program) skips the gather and returns [B, S, vocab].
             with jax.named_scope("serve_head"):
-                idx = decode_ctx["last_index"].astype(jnp.int32)  # [B]
-                x = jnp.take_along_axis(
-                    x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-                x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                            name="final_norm")(x)
-                logits = nn.Dense(self.vocab_size, use_bias=False,
-                                  dtype=self.dtype,
-                                  param_dtype=self.param_dtype,
-                                  name="lm_head")(x)
+                norm = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                               name="final_norm")
+                head = nn.Dense(self.vocab_size, use_bias=False,
+                                dtype=self.dtype,
+                                param_dtype=self.param_dtype, name="lm_head")
+                if decode_ctx.get("all_logits"):
+                    # Score every draft position through the SAME [B, d]
+                    # head matmul shape the decode program uses (unrolled
+                    # over the small verify width) rather than one
+                    # [B, S, vocab] matmul: XLA lowers the rank-3 head
+                    # differently (bf16 materialization vs fused fp32
+                    # accumulation), and that sub-bf16 numerical skew can
+                    # flip near-tie argmaxes — which would break the
+                    # bit-identity contract between speculative verify and
+                    # plain decode.
+                    # The fp32 cast must land INSIDE the stack: XLA fuses
+                    # convert(dot) into an fp32-accumulated matmul, and the
+                    # decode program gets that fusion — a stack between dot
+                    # and convert would materialize bf16 logits instead and
+                    # reintroduce grid ties.
+                    logits = jnp.stack(
+                        [head(norm(x[:, m])).astype(self.logits_dtype)
+                         for m in range(x.shape[1])], axis=1)
+                else:
+                    idx = decode_ctx["last_index"].astype(jnp.int32)  # [B]
+                    x = jnp.take_along_axis(
+                        x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+                    logits = head(norm(x))
             return logits.astype(self.logits_dtype)
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)

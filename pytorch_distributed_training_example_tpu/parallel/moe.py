@@ -591,12 +591,23 @@ class MoEBlock(nn.Module):
     ep_overlap_chunks: int = 2
 
     @nn.compact
-    def __call__(self, x, train: bool = True):  # x: [B, S, d]
+    def __call__(self, x, train: bool = True,
+                 decode: bool = False):  # x: [B, S, d]
         B, S, d = x.shape
         E = self.num_experts
         tokens = x.reshape(B * S, d)
         T = B * S
-        dropless = self.dispatch_impl == "dropless"
+        # Serving decode (models/llama.py threads ``decode_ctx`` down as
+        # ``decode=True``) always routes DROPLESS, whatever dispatch_impl
+        # the checkpoint trained with: capacity-dropped dispatch is
+        # non-causal — a token's drop depends on capacity competition from
+        # tokens AFTER it and on capacity = f(T) itself — so it has no
+        # exact incremental equivalent, while dropless routing is
+        # per-token-independent (bitwise row-invariant, r14/r17 contract)
+        # and therefore identical between the [T_train] training forward
+        # and [B*S] batch-decode shapes. Params are shared across impls
+        # (``experts/w_up``/``w_down``), so this is a pure routing switch.
+        dropless = self.dispatch_impl == "dropless" or decode
         if self.ep_dispatch != "replicated" and not dropless:
             raise ValueError(
                 f"ep_dispatch={self.ep_dispatch!r} only applies to "

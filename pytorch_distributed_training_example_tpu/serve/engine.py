@@ -40,6 +40,22 @@ r17 grows three serving-throughput layers on the same skeleton:
   program and inserted into the decode pool through another, so the
   decode batch never shares a step with a prefill. ``DisaggregatedServe``
   drives such a pair behind the single-engine interface.
+
+r19 adds SPECULATIVE DECODING (``spec_decode=``): a draft proposer
+(serve/spec_decode.py — self-drafting n-gram lookup by default, or a
+separate small draft model) guesses up to K tokens per slot, ONE batched
+verify forward (the history-attention program with ``all_logits``)
+scores all K+1 positions, and exact greedy acceptance (Leviathan et al.
+2023) keeps the longest draft prefix matching the model's own argmax
+plus one bonus token — so the emitted stream is bit-identical to the
+unsped engine while each accepted token skips a decode step. The paged
+cache rolls back over rejected positions for free (attention masks on
+position; stale entries are overwritten by later appends) and overshoot
+PAGES are dropped refcount-safely. Draft lengths are bucketed like
+batch/prompt buckets, so verify programs precompile at warmup and the
+steady state still never recompiles; the per-step host sync stays at
+exactly one — the verify fetch carries scores AND echoed draft tokens
+in a single stacked array.
 """
 
 from __future__ import annotations
@@ -144,6 +160,8 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = False,
                  prefill_chunk: int = 0,
                  role: str = "both",
+                 spec_decode: Any = None,
+                 draft_len: int = 4,
                  telemetry=None, metrics=None,
                  clock: Callable[[], float] = time.perf_counter):
         if role not in ("both", "prefill", "decode"):
@@ -177,7 +195,7 @@ class ContinuousBatchingEngine:
         self.pool = PagePool(spec.num_pages)
         self.prefix_cache = (PrefixCache(self.pool, spec.page_size)
                              if prefix_cache else None)
-        self.cache = kv_cache.init_cache(spec)
+        self.cache = self._init_cache()
         self.waiting: collections.deque[Request] = collections.deque()
         max_b = self.decode_buckets[-1]
         self.slots: list[Request | None] = [None] * max_b
@@ -197,13 +215,53 @@ class ContinuousBatchingEngine:
         self.stats = {"compiles": 0, "prefills": 0, "decode_steps": 0,
                       "tokens_generated": 0, "evictions": 0, "admitted": 0,
                       "prompt_tokens": 0, "cached_tokens": 0,
-                      "cow_copies": 0, "handoffs_out": 0, "handoffs_in": 0}
+                      "cow_copies": 0, "handoffs_out": 0, "handoffs_in": 0,
+                      "spec_steps": 0, "draft_tokens": 0,
+                      "accepted_tokens": 0}
         self._compiled: dict[tuple, Any] = {}
+        # Speculative decoding: ``spec_decode`` is None/"off", the string
+        # "ngram" (build the default self-drafting proposer), or a
+        # proposer object (serve/spec_decode.py protocol: attach/warmup/
+        # begin/release/propose). A prefill-role engine never decodes, so
+        # it never speculates. Draft lengths bucket like batch buckets:
+        # verify programs compile once per (decode bucket, draft bucket)
+        # at warmup and the compile count stays flat afterwards.
+        self.draft_len = int(draft_len)
+        if self.draft_len < 1:
+            raise ValueError(f"draft_len={draft_len} must be >= 1")
+        self.draft_buckets = tuple(
+            b for b in (1, 2, 4, 8, 16) if b < self.draft_len
+        ) + (self.draft_len,)
+        if spec_decode in (None, False, "", "off") or role == "prefill":
+            self.proposer = None
+        elif spec_decode == "ngram":
+            from pytorch_distributed_training_example_tpu.serve import (
+                spec_decode as spec_decode_lib)
+            self.proposer = spec_decode_lib.NGramProposer(self.draft_len)
+        elif isinstance(spec_decode, str):
+            raise ValueError(
+                f"unknown spec_decode mode {spec_decode!r}: expected 'off', "
+                "'ngram', or a proposer object (e.g. DraftModelProposer)")
+        else:
+            self.proposer = spec_decode
+        if self.proposer is not None:
+            # Accepted-length histogram rides the stats dict as plain int
+            # keys so DisaggregatedServe / router stat merges stay trivial.
+            for n in range(self.draft_len + 1):
+                self.stats[f"spec_accept_{n}"] = 0
+            self.proposer.attach(self)
         self._t0 = self._clock()
+
+    def _init_cache(self):
+        """Zeroed pools matching the cache pytree the MODEL declares —
+        per-block for unrolled models, one stacked [L, ...] carry under
+        ``scan_layers`` (kv_cache.init_model_cache)."""
+        return kv_cache.init_model_cache(self.module, self.spec,
+                                         self.table_width, self.attn_impl)
 
     # ---------------------------------------------------------------- steps
 
-    def _decode_fn(self, history: bool = False):
+    def _decode_fn(self, history: bool = False, all_logits: bool = False):
         spec = self.spec
 
         def run(params, cache, tokens, positions, page_table, last_index):
@@ -212,10 +270,17 @@ class ContinuousBatchingEngine:
                 decode_ctx=dict(positions=positions, page_table=page_table,
                                 cache_spec=(spec.num_pages, spec.page_size),
                                 last_index=last_index, history=history,
+                                all_logits=all_logits,
                                 attn_impl=self.attn_impl),
                 mutable=["cache"])
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    vs["cache"])
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if all_logits:
+                # Verify step: stack the per-position argmax with the ECHOED
+                # input tokens, so the host acceptance loop reads drafts and
+                # scores out of one fetched array — device-side proposers
+                # (draft model) never force a second device->host sync.
+                out = jnp.stack([out, tokens.astype(jnp.int32)], axis=1)
+            return out, vs["cache"]
 
         return run
 
@@ -230,8 +295,10 @@ class ContinuousBatchingEngine:
         source of truth the no-recompile test asserts on."""
         key = (kind, batch, seq)
         if key not in self._compiled:
-            fn = jax.jit(self._decode_fn(history=(kind == "prefill_hist")),
-                         donate_argnums=1)
+            fn = jax.jit(
+                self._decode_fn(history=kind in ("prefill_hist", "verify"),
+                                all_logits=kind == "verify"),
+                donate_argnums=1)
             args = (
                 self._abstract(self.params), self._abstract(self.cache),
                 jax.ShapeDtypeStruct((batch, seq), jnp.int32),
@@ -261,9 +328,13 @@ class ContinuousBatchingEngine:
                 lowered = fn.lower(cache_abs, ids_abs)
             elif kind == "import":
                 fn = jax.jit(kv_cache.insert_pages, donate_argnums=0)
+                # Page axis is ndim-4 on every pool leaf (scanned stacks
+                # carry a leading layer dim) — the handoff block swaps it
+                # for the fixed table width.
                 block_abs = jax.tree.map(
                     lambda s: jax.ShapeDtypeStruct(
-                        (self.table_width,) + s.shape[1:], s.dtype),
+                        s.shape[:-4] + (self.table_width,) + s.shape[-3:],
+                        s.dtype),
                     cache_abs)
                 lowered = fn.lower(cache_abs, block_abs, ids_abs)
             else:
@@ -279,6 +350,10 @@ class ContinuousBatchingEngine:
         if self.role in ("both", "decode"):
             for b in self.decode_buckets:
                 self._get_step("decode", b, 1)
+            if self.proposer is not None:
+                for b in self.decode_buckets:
+                    for w in self.draft_buckets:
+                        self._get_step("verify", b, w + 1)
         if self.role in ("both", "prefill"):
             for sp in self.prompt_buckets:
                 self._get_step("prefill", 1, sp)
@@ -291,7 +366,10 @@ class ContinuousBatchingEngine:
             self._get_aux("export")
         if self.role == "decode":
             self._get_aux("import")
-        return len(self._compiled)
+        n = len(self._compiled)
+        if self.proposer is not None:
+            n += self.proposer.warmup(self)
+        return n
 
     # ------------------------------------------------------------ scheduling
 
@@ -483,6 +561,9 @@ class ContinuousBatchingEngine:
         if self.role == "prefill" and not req.finished(self.max_model_len):
             self._handoff(slot, req, first)
         else:
+            if self.proposer is not None \
+                    and not req.finished(self.max_model_len):
+                self.proposer.begin(self, slot, req)
             self._retire(slot)
 
     def _handoff(self, slot: int, req: Request, first: int) -> None:
@@ -518,6 +599,8 @@ class ContinuousBatchingEngine:
         self._next_tok[slot] = handoff.next_token
         self.stats["handoffs_in"] += 1
         self.stats["admitted"] += 1
+        if self.proposer is not None:
+            self.proposer.begin(self, slot, req)
 
     def _drain_inbox(self) -> None:
         while self._inbox:
@@ -526,23 +609,32 @@ class ContinuousBatchingEngine:
                 break
             self._place(self._inbox.popleft(), slot)
 
-    def _ensure_pages(self) -> None:
+    def _ensure_pages(self, extra: dict[int, int] | None = None) -> None:
         """Every active slot must be able to take its NEXT append: the
         target page must exist (allocate incrementally) and be private
         (copy-on-write if its pool refcount exceeds one — someone else,
         possibly the prefix cache, still reads the original bytes).
+        ``extra[slot]`` widens the write window for a speculative verify
+        step — positions ``len .. len+extra`` all land this step, so
+        every page in that range must exist and be private up front.
         Evicts the youngest request on OOM."""
         while True:
             pending = None
             for i, req in enumerate(self.slots):
                 if req is None:
                     continue
-                idx = int(self._lens[i]) // self.spec.page_size
-                if idx >= len(self._pages[i]):
-                    pending = (i, "grow", idx)
-                    break
-                if self.pool.refcount(self._pages[i][idx]) > 1:
-                    pending = (i, "cow", idx)
+                ps = self.spec.page_size
+                lo = int(self._lens[i]) // ps
+                hi = (int(self._lens[i]) + (extra.get(i, 0) if extra else 0)
+                      ) // ps
+                for idx in range(lo, hi + 1):
+                    if idx >= len(self._pages[i]):
+                        pending = (i, "grow", idx)
+                        break
+                    if self.pool.refcount(self._pages[i][idx]) > 1:
+                        pending = (i, "cow", idx)
+                        break
+                if pending is not None:
                     break
             if pending is None:
                 return
@@ -560,6 +652,8 @@ class ContinuousBatchingEngine:
 
     def _release_slot(self, slot: int) -> None:
         req = self.slots[slot]
+        if self.proposer is not None:
+            self.proposer.release(slot)
         self.pool.free(req.request_id)
         nodes = self._nodes.pop(req.request_id, None)
         if nodes and self.prefix_cache is not None:
@@ -647,47 +741,173 @@ class ContinuousBatchingEngine:
             produced = self._advance_prefills()
             self._export_metrics()
             return produced
-        active = [i for i, r in enumerate(self.slots) if r is not None]
         produced = 0
-        if active:
-            self._ensure_pages()
-            active = [i for i, r in enumerate(self.slots) if r is not None]
-        if active:
-            bucket = _bucket(len(active), self.decode_buckets)
-            rows = active + [i for i in range(len(self.slots))
-                             if i not in active][:bucket - len(active)]
-            rows = rows[:bucket]
-            tokens = self._next_tok[rows][:, None].copy()
-            positions = self._lens[rows][:, None].copy()
-            table = self._tables[rows].copy()
-            # Inactive filler rows: scratch page table, position 0, token 0.
-            for j, i in enumerate(rows):
-                if self.slots[i] is None:
-                    tokens[j] = 0
-                    positions[j] = 0
-                    table[j] = 0
-            step = self._get_step("decode", bucket, 1)
-            with self._span("decode" if self.role == "decode" else "step"):
-                tok, self.cache = step(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(positions), jnp.asarray(table),
-                    np.zeros(bucket, np.int32))
-                out = np.asarray(tok)
-            now = self._clock()
-            self.stats["decode_steps"] += 1
-            for j, i in enumerate(rows):
-                req = self.slots[i]
-                if req is None:
-                    continue
-                req.generated.append(int(out[j]))
-                req.token_times.append(now)
-                self._lens[i] += 1
-                self._next_tok[i] = int(out[j])
-                produced += 1
-                self._retire(i)
-            self.stats["tokens_generated"] += produced
+        if self.num_active:
+            if self.proposer is not None:
+                produced = self._spec_step()
+            else:
+                self._ensure_pages()
+                produced = self._decode_step()
         self._export_metrics()
         return produced
+
+    def _batch_rows(self, active: list[int]) -> tuple[int, list[int]]:
+        bucket = _bucket(len(active), self.decode_buckets)
+        rows = active + [i for i in range(len(self.slots))
+                         if i not in active][:bucket - len(active)]
+        return bucket, rows[:bucket]
+
+    def _decode_step(self) -> int:
+        """One plain (non-speculative) decode step over the active slots,
+        padded to a batch bucket. Callers run ``_ensure_pages`` first."""
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return 0
+        bucket, rows = self._batch_rows(active)
+        tokens = self._next_tok[rows][:, None].copy()
+        positions = self._lens[rows][:, None].copy()
+        table = self._tables[rows].copy()
+        # Inactive filler rows: scratch page table, position 0, token 0.
+        for j, i in enumerate(rows):
+            if self.slots[i] is None:
+                tokens[j] = 0
+                positions[j] = 0
+                table[j] = 0
+        step = self._get_step("decode", bucket, 1)
+        with self._span("decode" if self.role == "decode" else "step"):
+            tok, self.cache = step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(table),
+                np.zeros(bucket, np.int32))
+            out = np.asarray(tok)
+        now = self._clock()
+        self.stats["decode_steps"] += 1
+        produced = 0
+        for j, i in enumerate(rows):
+            req = self.slots[i]
+            if req is None:
+                continue
+            req.generated.append(int(out[j]))
+            req.token_times.append(now)
+            self._lens[i] += 1
+            self._next_tok[i] = int(out[j])
+            produced += 1
+            self._retire(i)
+        self.stats["tokens_generated"] += produced
+        return produced
+
+    def _spec_step(self) -> int:
+        """One speculative iteration: propose up to K drafts per slot,
+        score all K+1 positions in ONE batched verify forward, accept the
+        longest draft prefix that matches the model's own greedy argmax
+        plus one bonus token (exact — emitted tokens are bit-identical to
+        the unsped engine's), then roll the cache back over the overshoot.
+
+        The verify program is the history-attention flavor at
+        ``all_logits``: position ``len+m`` scores input m, and its output
+        row echoes the input tokens so the single ``np.asarray`` fetch
+        carries drafts and scores together (one host sync per step)."""
+        ps = self.spec.page_size
+        cap = self.table_width * ps - 1
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        # Per-slot draft budget: speculation must not run past a stop
+        # condition the unsped engine would hit — at most remaining-1
+        # drafts (so accepted+bonus <= tokens left) and never a write
+        # position beyond the model length.
+        budgets = {}
+        for i in active:
+            req = self.slots[i]
+            remaining = req.max_new_tokens - len(req.generated)
+            budgets[i] = max(0, min(self.draft_len, remaining - 1,
+                                    self.max_model_len - 1
+                                    - int(self._lens[i])))
+        counts, values = self.proposer.propose(self, active, budgets)
+        n_draft = {i: int(counts.get(i, 0)) for i in active}
+        d_max = max(n_draft.values(), default=0)
+        self._ensure_pages(extra=n_draft if d_max else None)
+        survivors = [i for i, r in enumerate(self.slots) if r is not None]
+        if d_max == 0 or survivors != active:
+            # Nothing proposed (or an eviction invalidated the proposal
+            # batch): fall back to a plain decode step this iteration.
+            return self._decode_step()
+        width = _bucket(d_max, self.draft_buckets) + 1
+        bucket, rows = self._batch_rows(active)
+        tokens = np.zeros((bucket, width), np.int32)
+        positions = np.zeros((bucket, width), np.int32)
+        table = np.zeros((bucket, self.table_width), np.int32)
+        for j, i in enumerate(rows):
+            if self.slots[i] is None:
+                continue
+            tokens[j, 0] = self._next_tok[i]
+            if isinstance(values, dict):
+                d = values.get(i, ())
+                tokens[j, 1:1 + len(d)] = d
+            positions[j] = np.minimum(
+                int(self._lens[i]) + np.arange(width, dtype=np.int32), cap)
+            table[j] = self._tables[i]
+        tok_dev = jnp.asarray(tokens)
+        if not isinstance(values, dict):
+            # Device-resident drafts (draft-model proposer): scatter them
+            # in without ever fetching them — the verify echo returns them.
+            tok_dev = tok_dev.at[:len(active), 1:1 + values.shape[1]].set(
+                values.astype(jnp.int32))
+        step = self._get_step("verify", bucket, width)
+        with self._span("decode" if self.role == "decode" else "step"):
+            out, self.cache = step(
+                self.params, self.cache, tok_dev,
+                jnp.asarray(positions), jnp.asarray(table),
+                np.zeros(bucket, np.int32))
+            fetched = np.asarray(out)    # [bucket, 2, width]: scores, echo
+        now = self._clock()
+        self.stats["decode_steps"] += 1
+        self.stats["spec_steps"] += 1
+        produced = 0
+        for j, i in enumerate(rows):
+            req = self.slots[i]
+            if req is None:
+                continue
+            scored, echoed = fetched[j, 0], fetched[j, 1]
+            k = n_draft[i]
+            n_acc = 0
+            while n_acc < k and int(echoed[n_acc + 1]) == int(scored[n_acc]):
+                n_acc += 1
+            # Emit accepted drafts + the bonus token one at a time, exactly
+            # like the unsped loop would — an eos mid-acceptance truncates.
+            for t in [int(x) for x in echoed[1:1 + n_acc]] \
+                    + [int(scored[n_acc])]:
+                req.generated.append(t)
+                req.token_times.append(now)
+                self._lens[i] += 1
+                produced += 1
+                if req.finished(self.max_model_len):
+                    break
+            self._next_tok[i] = req.generated[-1]
+            self.stats["draft_tokens"] += k
+            self.stats["accepted_tokens"] += n_acc
+            self.stats[f"spec_accept_{n_acc}"] += 1
+            self._rollback(i)
+            self._retire(i)
+        self.stats["tokens_generated"] += produced
+        return produced
+
+    def _rollback(self, slot: int) -> None:
+        """Drop the OVERSHOOT pages a verify step grew past the accepted
+        length. Stale cache entries within kept pages need no cleanup —
+        attention masks on position and later appends overwrite them —
+        but whole pages beyond the next write target go back to the pool
+        (refcount-safe: prompt pages shared with the prefix cache always
+        precede the accepted length, so only private growth is dropped)."""
+        req = self.slots[slot]
+        if req is None:
+            return
+        keep = min(len(self._pages[slot]),
+                   int(self._lens[slot]) // self.spec.page_size + 1)
+        if keep >= len(self._pages[slot]):
+            return
+        for page in self._pages[slot][keep:]:
+            self.pool.drop(req.request_id, page)
+        self._tables[slot, keep:len(self._pages[slot])] = 0
+        del self._pages[slot][keep:]
 
     def run(self, max_steps: int = 100000) -> list[Request]:
         """Drain every submitted request; returns the completed list."""
@@ -709,8 +929,16 @@ class ContinuousBatchingEngine:
             return
         elapsed = max(self._clock() - self._t0, 1e-9)
         extra = {}
+        if self.proposer is not None:
+            extra.update(
+                serve_spec_steps=self.stats["spec_steps"],
+                serve_draft_tokens=self.stats["draft_tokens"],
+                serve_accepted_tokens=self.stats["accepted_tokens"],
+                serve_accept_rate=self.stats["accepted_tokens"]
+                / max(self.stats["draft_tokens"], 1),
+            )
         if self.prefix_cache is not None:
-            extra = dict(
+            extra.update(
                 serve_prefix_hit_rate=self.prefix_hit_rate(),
                 serve_cached_pages=self.prefix_cache.cached_pages,
                 serve_cow_copies=self.stats["cow_copies"],

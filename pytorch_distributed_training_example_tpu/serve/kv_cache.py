@@ -165,7 +165,9 @@ class PagePool:
 
 def init_cache(spec: CacheSpec) -> dict:
     """Zeroed K/V pools for every layer, keyed like the flax ``cache``
-    collection the model's decode path declares (``block_i/attn``)."""
+    collection an UNROLLED model's decode path declares (``block_i/attn``).
+    Prefer ``init_model_cache`` — it derives the pytree from the model
+    itself and therefore also covers ``scan_layers`` stacked pools."""
     shape = spec.layer_shape()
     return {
         f"block_{i}": {"attn": {
@@ -174,6 +176,30 @@ def init_cache(spec: CacheSpec) -> dict:
         }}
         for i in range(spec.num_layers)
     }
+
+
+def init_model_cache(module, spec: CacheSpec, table_width: int,
+                     attn_impl: str = "auto") -> dict:
+    """Zeroed K/V pools matching the cache structure ``module`` itself
+    declares, derived via ``jax.eval_shape`` over ``module.init`` — so
+    unrolled blocks (per-block [P, page_size, Hkv, D] pools) and
+    ``scan_layers`` models (one stacked [L, P, page_size, Hkv, D] carry)
+    both get the right pytree without callers hardcoding either layout.
+    Shape-only: no parameters are materialized and nothing runs."""
+
+    def init_fn():
+        return module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+            train=False,
+            decode_ctx=dict(
+                positions=jnp.zeros((1, 1), jnp.int32),
+                page_table=jnp.zeros((1, table_width), jnp.int32),
+                cache_spec=(spec.num_pages, spec.page_size),
+                last_index=jnp.zeros((1,), jnp.int32),
+                history=False, attn_impl=attn_impl))
+
+    shapes = jax.eval_shape(init_fn)["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def append_pages(pages: jax.Array, new: jax.Array, page_table: jax.Array,
@@ -206,8 +232,15 @@ def copy_page(cache: dict, src: jax.Array, dst: jax.Array) -> dict:
     ``src``/``dst`` are scalar int32 page ids, so one compiled program
     serves every COW event — the engine traces this once and replays it
     whenever a write would land in a page whose refcount exceeds one.
+
+    Pool leaves are [P, page_size, Hkv, D] for unrolled blocks, or
+    [L, P, page_size, Hkv, D] when ``scan_layers`` stacks every block's
+    pool into one scanned carry — the page axis is ``ndim - 4`` either
+    way, so each op rank-dispatches on the leaf.
     """
     def _cp(pages: jax.Array) -> jax.Array:
+        if pages.ndim == 5:  # scanned stack: page axis 1
+            return pages.at[:, dst].set(pages[:, src])
         return pages.at[dst].set(pages[src])
 
     return jax.tree.map(_cp, cache)
@@ -219,9 +252,14 @@ def extract_pages(cache: dict, page_ids: jax.Array) -> dict:
     ``page_ids`` is a [W] int32 vector padded with the scratch page, so
     one compiled program covers every prefill→decode handoff regardless
     of how many pages the sequence actually owns. Returns a pytree of
-    [W, page_size, Hkv, D] blocks.
+    [W, page_size, Hkv, D] blocks ([L, W, ...] for scanned stacks).
     """
-    return jax.tree.map(lambda pages: pages[page_ids], cache)
+    def _ex(pages: jax.Array) -> jax.Array:
+        if pages.ndim == 5:
+            return pages[:, page_ids]
+        return pages[page_ids]
+
+    return jax.tree.map(_ex, cache)
 
 
 def insert_pages(cache: dict, block: dict, page_ids: jax.Array) -> dict:
@@ -230,9 +268,12 @@ def insert_pages(cache: dict, block: dict, page_ids: jax.Array) -> dict:
     Padded rows target the scratch page, so their stale contents collide
     harmlessly on page 0 — the decode-side half of the KV handoff.
     """
-    return jax.tree.map(
-        lambda pages, b: pages.at[page_ids].set(b.astype(pages.dtype)),
-        cache, block)
+    def _ins(pages: jax.Array, b: jax.Array) -> jax.Array:
+        if pages.ndim == 5:
+            return pages.at[:, page_ids].set(b.astype(pages.dtype))
+        return pages.at[page_ids].set(b.astype(pages.dtype))
+
+    return jax.tree.map(_ins, cache, block)
 
 
 def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:

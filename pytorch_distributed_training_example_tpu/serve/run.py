@@ -90,6 +90,36 @@ def main(cfg) -> dict:
                                       page_size=cfg.serve_page_size)
     buckets = lambda s: tuple(int(t) for t in s.split(",") if t)
 
+    def build_proposer():
+        """Speculative-decode proposer per replica (r19). "ngram" is a
+        string the engine resolves itself; "draft" builds a separate
+        small model, params-only restored when the flag names a
+        checkpoint as "name@dir" (mirroring the target's restore)."""
+        mode = cfg.serve_spec_decode
+        if mode in ("", "off"):
+            return None
+        if mode == "ngram":
+            return "ngram"
+        if mode != "draft":
+            raise SystemExit(f"unknown --serve-spec-decode {mode!r}")
+        if not cfg.serve_draft_model:
+            raise SystemExit("--serve-spec-decode draft needs "
+                             "--serve-draft-model")
+        from pytorch_distributed_training_example_tpu.serve import (
+            spec_decode as spec_decode_lib)
+
+        name, _, draft_dir = cfg.serve_draft_model.partition("@")
+        draft = registry.create_model(name, seq_len=cfg.seq_len,
+                                      dtype=dtype, param_dtype=dtype)
+        dparams = draft.module.init(
+            jax.random.PRNGKey(cfg.seed),
+            jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+        if draft_dir:
+            dparams, _ = ckpt_lib.Checkpointer(draft_dir).restore_params(
+                dparams)
+        return spec_decode_lib.DraftModelProposer(
+            draft.module, dparams, draft_len=cfg.serve_draft_len)
+
     def build_replica():
         """One serve replica: a single engine, or a prefill/decode pair
         under --serve-disaggregate. All replicas share module + params
@@ -98,6 +128,8 @@ def main(cfg) -> dict:
                   prompt_buckets=buckets(cfg.serve_prompt_buckets),
                   max_model_len=cfg.serve_max_model_len or None,
                   metrics=metrics)
+        spec_kw = dict(spec_decode=build_proposer(),
+                       draft_len=cfg.serve_draft_len)
         if cfg.serve_disaggregate:
             return engine_lib.DisaggregatedServe(
                 engine_lib.ContinuousBatchingEngine(
@@ -105,10 +137,10 @@ def main(cfg) -> dict:
                     prefix_cache=cfg.serve_prefix_cache,
                     prefill_chunk=cfg.serve_prefill_chunk, **kw),
                 engine_lib.ContinuousBatchingEngine(
-                    module, params, spec, role="decode", **kw))
+                    module, params, spec, role="decode", **spec_kw, **kw))
         return engine_lib.ContinuousBatchingEngine(
             module, params, spec, prefix_cache=cfg.serve_prefix_cache,
-            prefill_chunk=cfg.serve_prefill_chunk, **kw)
+            prefill_chunk=cfg.serve_prefill_chunk, **spec_kw, **kw)
 
     if cfg.serve_replicas > 1:
         from pytorch_distributed_training_example_tpu.serve import (
@@ -188,6 +220,19 @@ def main(cfg) -> dict:
                               / max(stats["prompt_tokens"], 1), 4),
             "cached_tokens": stats["cached_tokens"],
             "cow_copies": stats["cow_copies"],
+        }
+    if cfg.serve_spec_decode not in ("", "off"):
+        drafted = stats.get("draft_tokens", 0)
+        result["spec_decode"] = {
+            "mode": cfg.serve_spec_decode,
+            "spec_steps": stats.get("spec_steps", 0),
+            "draft_tokens": drafted,
+            "accepted_tokens": stats.get("accepted_tokens", 0),
+            "accept_rate": round(stats.get("accepted_tokens", 0)
+                                 / max(drafted, 1), 4),
+            "accepted_len_hist": {
+                n: stats.get(f"spec_accept_{n}", 0)
+                for n in range(cfg.serve_draft_len + 1)},
         }
     if cfg.serve_disaggregate:
         result["handoffs"] = stats["handoffs_out"]

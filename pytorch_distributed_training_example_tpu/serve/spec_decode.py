@@ -1,0 +1,310 @@
+"""Draft proposers for speculative decoding (serve/engine.py r19).
+
+Speculative decoding (Leviathan et al. 2023, arXiv:2211.17192) splits a
+decode step into a cheap GUESS and one batched CHECK: a proposer drafts
+up to K candidate tokens per slot, the target model scores all K+1
+positions in a single verify forward (the engine's history-attention
+program with ``all_logits``), and exact greedy acceptance keeps the
+longest draft prefix that matches the target's own argmax plus one bonus
+token. Output is bit-identical to the unsped engine — the proposer only
+moves WHERE the FLOPs are spent, never what is emitted — so draft
+quality is purely a throughput knob: mean accepted length sets the
+tokens-per-verify multiplier.
+
+Two proposers, one protocol (``attach``/``warmup``/``begin``/``release``/
+``propose``):
+
+- ``NGramProposer`` (default): self-drafting prompt lookup — match the
+  most recent n-gram of the context against its own earlier tokens and
+  propose the continuation that followed last time. Pure host
+  bookkeeping: zero device work, zero params, deterministic. Strong on
+  repetitive continuations (code, extraction, templated text), useless
+  on novel text — which costs only the draft bookkeeping, since a
+  0-length draft falls back to a plain decode step.
+- ``DraftModelProposer``: a separate small decode-capable model (params
+  restored params-only, same as the target) autoregressively drafts K
+  tokens against its OWN paged cache pool. Every program is bucketed and
+  AOT-warmed like the target's (compiles counted in the engine's
+  ``stats["compiles"]``), and the drafted tokens STAY ON DEVICE — the
+  engine scatters them into the verify batch and reads them back through
+  the verify fetch's echoed row, keeping the one-host-sync-per-step
+  contract.
+
+The draft cache needs no rollback machinery: each proposal round
+re-appends the last two real context tokens (positions L-1, L) through
+the catch-up program before drafting, so positions a rejected draft left
+stale are overwritten sequentially before any later query reads them —
+the same masks-on-position argument the target cache relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_example_tpu.serve import kv_cache
+from pytorch_distributed_training_example_tpu.serve.kv_cache import (
+    CacheSpec, PagePool, pages_for_tokens)
+
+
+class NGramProposer:
+    """Self-drafting prompt-lookup: propose the continuation that followed
+    the most recent earlier occurrence of the context's trailing n-gram
+    (longest n first). Host-only and deterministic."""
+
+    def __init__(self, draft_len: int = 4, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]")
+        self.draft_len = int(draft_len)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def attach(self, engine) -> None:
+        pass
+
+    def warmup(self, engine) -> int:
+        return 0
+
+    def begin(self, engine, slot: int, req) -> None:
+        pass
+
+    def release(self, slot: int) -> None:
+        pass
+
+    def propose(self, engine, active: list[int],
+                budgets: dict[int, int]) -> tuple[dict[int, int], dict]:
+        counts: dict[int, int] = {}
+        values: dict[int, list[int]] = {}
+        for i in active:
+            req = engine.slots[i]
+            d = self._match(req.prompt + req.generated,
+                            min(budgets[i], self.draft_len))
+            counts[i] = len(d)
+            values[i] = d
+        return counts, values
+
+    def _match(self, ctx: list[int], k: int) -> list[int]:
+        if k <= 0:
+            return []
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if len(ctx) <= n:
+                continue
+            tail = ctx[-n:]
+            # Most recent earlier occurrence whose continuation is
+            # non-empty (s + n <= len(ctx) - 1).
+            for s in range(len(ctx) - n - 1, -1, -1):
+                if ctx[s:s + n] == tail:
+                    return ctx[s + n:s + n + k]
+        return []
+
+
+class DraftModelProposer:
+    """Small-model drafting against a private paged cache.
+
+    Per proposal round and batch bucket: one width-2 catch-up forward
+    (re-appends the last two accepted context tokens at positions
+    [L-1, L] and returns the draft's argmax after L — re-appending an
+    already-cached position rewrites the same K/V, so no separate
+    catch-up state is tracked), then K-1 single-token decode steps, each
+    feeding the previous argmax back WITHOUT leaving the device. The
+    drafted [B, K] block is handed to the engine as a device array.
+
+    The draft pool mirrors the target's geometry (same page size / table
+    width so position arithmetic is shared) but is wholly private: no
+    prefix cache, no COW, no handoffs. ``begin`` prefills the prompt
+    through bucketed windows when a slot is (re)admitted; ``release``
+    frees the slot's pages. Pool sizing defaults to the target's
+    ``num_pages`` plus one page per slot of draft overshoot.
+    """
+
+    def __init__(self, module, params, *, num_pages: int | None = None,
+                 draft_len: int = 4):
+        self.module = module
+        self.params = params
+        self.draft_len = int(draft_len)
+        self._num_pages = num_pages
+        self.engine = None
+        self._compiled: dict[tuple, Any] = {}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def attach(self, engine) -> None:
+        self.engine = engine
+        ps = engine.spec.page_size
+        num_pages = self._num_pages or (engine.spec.num_pages
+                                        + len(engine.slots))
+        self.spec = CacheSpec(
+            num_layers=self.module.num_layers, num_pages=num_pages,
+            page_size=ps, num_kv_heads=self.module.num_kv_heads,
+            head_dim=self.module.head_dim, dtype=self.module.dtype)
+        self.table_width = engine.table_width
+        self.pool = PagePool(num_pages)
+        self.cache = kv_cache.init_model_cache(
+            self.module, self.spec, self.table_width, engine.attn_impl)
+        max_b = len(engine.slots)
+        self._tables = np.zeros((max_b, self.table_width), np.int32)
+        self._pages: list[list[int]] = [[] for _ in range(max_b)]
+
+    def warmup(self, engine) -> int:
+        for b in engine.decode_buckets:
+            self._get_step("draft_decode", b, 1)
+            self._get_step("draft_catchup", b, 2)
+        for sp in engine.prompt_buckets:
+            self._get_step("draft_prefill", 1, sp)
+            self._get_step("draft_prefill_hist", 1, sp)
+        return len(self._compiled)
+
+    def begin(self, engine, slot: int, req) -> None:
+        """(Re)admission: prefill the PROMPT into the draft cache — the
+        generated tokens stream in through later catch-ups."""
+        self.release(slot)
+        plen = len(req.prompt)
+        need = pages_for_tokens(plen + self.draft_len + 1,
+                                self.spec.page_size)
+        self._grow(slot, need)
+        cap = self._window_cap(engine)
+        pos = 0
+        while pos < plen:
+            n = min(plen - pos, cap)
+            self._prefill_window(engine, slot, req, pos, n)
+            pos += n
+
+    def release(self, slot: int) -> None:
+        self.pool.free(f"slot-{slot}")
+        self._pages[slot] = []
+        self._tables[slot] = 0
+
+    # ------------------------------------------------------------- programs
+
+    def _decode_fn(self, history: bool):
+        spec = self.spec
+
+        def run(params, cache, tokens, positions, page_table, last_index):
+            logits, vs = self.module.apply(
+                {"params": params, "cache": cache}, tokens, train=False,
+                decode_ctx=dict(positions=positions, page_table=page_table,
+                                cache_spec=(spec.num_pages, spec.page_size),
+                                last_index=last_index, history=history,
+                                attn_impl=self.engine.attn_impl),
+                mutable=["cache"])
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    vs["cache"])
+
+        return run
+
+    def _get_step(self, kind: str, batch: int, seq: int):
+        """AOT-compiled draft program; compiles count toward the ENGINE's
+        ``stats["compiles"]`` so the no-steady-state-recompile assertion
+        covers the draft model too."""
+        key = (kind, batch, seq)
+        if key not in self._compiled:
+            hist = kind in ("draft_catchup", "draft_prefill_hist")
+            fn = jax.jit(self._decode_fn(history=hist), donate_argnums=1)
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.asarray(x).dtype),
+                (self.params, self.cache))
+            args = abstract + (
+                jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+                jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+                jax.ShapeDtypeStruct((batch, self.table_width), jnp.int32),
+                jax.ShapeDtypeStruct((batch,), jnp.int32),
+            )
+            self._compiled[key] = fn.lower(*args).compile()
+            self.engine.stats["compiles"] += 1
+        return self._compiled[key]
+
+    # ------------------------------------------------------------- internal
+
+    def _window_cap(self, engine) -> int:
+        return engine.prompt_buckets[-1]
+
+    def _grow(self, slot: int, need: int) -> None:
+        have = len(self._pages[slot])
+        if need <= have:
+            return
+        if not self.pool.can_alloc(need - have):
+            raise MemoryError(
+                f"draft page pool exhausted (want {need - have}, have "
+                f"{self.pool.num_free}): size DraftModelProposer num_pages "
+                "at least like the target pool")
+        pages = self.pool.alloc(f"slot-{slot}", need - have)
+        self._pages[slot].extend(pages)
+        self._tables[slot, have:have + len(pages)] = pages
+
+    def _prefill_window(self, engine, slot: int, req, pos: int,
+                        n: int) -> None:
+        """One draft prefill window; the output argmax is DISCARDED (the
+        first proposal round re-derives it through catch-up), so prefill
+        costs zero host syncs."""
+        sp = _bucket(n, engine.prompt_buckets)
+        kind = "draft_prefill_hist" if pos > 0 else "draft_prefill"
+        step = self._get_step(kind, 1, sp)
+        tokens = np.zeros((1, sp), np.int32)
+        tokens[0, :n] = req.prompt[pos:pos + n]
+        positions = np.minimum(pos + np.arange(sp, dtype=np.int32),
+                               self.table_width * self.spec.page_size - 1)
+        _, self.cache = step(self.params, self.cache, jnp.asarray(tokens),
+                             jnp.asarray(positions[None]),
+                             jnp.asarray(self._tables[slot:slot + 1]),
+                             np.asarray([n - 1], np.int32))
+
+    def propose(self, engine, active: list[int],
+                budgets: dict[int, int]) -> tuple[dict[int, int], Any]:
+        counts = {i: min(int(budgets[i]), self.draft_len) for i in active}
+        k_max = max(counts.values(), default=0)
+        if k_max == 0:
+            return counts, {}
+        ps = self.spec.page_size
+        cap = self.table_width * ps - 1
+        bucket = _bucket(len(active), engine.decode_buckets)
+        # Draft writes land at positions [L-1 .. L+k_max-1]; grow each
+        # slot's private pages to cover them (budget capping keeps real
+        # positions inside the table; padded rows clip onto scratch).
+        for i in active:
+            self._grow(i, pages_for_tokens(
+                int(engine._lens[i]) + k_max, ps))
+        tokens = np.zeros((bucket, 2), np.int32)
+        positions = np.zeros((bucket, 2), np.int32)
+        table = np.zeros((bucket, self.table_width), np.int32)
+        last = np.zeros(bucket, np.int32)
+        lens = np.zeros(bucket, np.int32)
+        for j, i in enumerate(active):
+            req = engine.slots[i]
+            ctx = req.prompt + req.generated
+            L = int(engine._lens[i])         # == len(ctx) - 1, >= 1
+            tokens[j] = (ctx[L - 1], ctx[L])
+            positions[j] = np.minimum((L - 1, L), cap)
+            table[j] = self._tables[i]
+            last[j] = 1
+            lens[j] = L
+        table_dev = jnp.asarray(table)
+        step = self._get_step("draft_catchup", bucket, 2)
+        cur, self.cache = step(self.params, self.cache, jnp.asarray(tokens),
+                               jnp.asarray(positions), table_dev,
+                               jnp.asarray(last))
+        cur = cur[:, None]                   # [bucket, 1] device, = d1
+        drafts = [cur]
+        dstep = self._get_step("draft_decode", bucket, 1)
+        for m in range(1, k_max):
+            pos_m = np.minimum(lens + m, cap)[:, None]
+            cur, self.cache = dstep(self.params, self.cache, cur,
+                                    jnp.asarray(pos_m), table_dev,
+                                    np.zeros(bucket, np.int32))
+            cur = cur[:, None]
+            drafts.append(cur)
+        values = jnp.concatenate(drafts, axis=1)[:len(active)]
+        return counts, values
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")

@@ -160,6 +160,14 @@ class Config:
     serve_templates: int = 0
     serve_zipf_a: float = 1.2
     serve_prefix_len: str = "16:32"   # template length range, "min:max"
+    # r19 speculative decoding (serve/spec_decode.py): "off" | "ngram"
+    # (self-drafting prompt lookup) | "draft" (separate small draft model
+    # named by serve_draft_model, params-only restored from an optional
+    # "name@ckpt_dir" suffix). Greedy output stays bit-identical to the
+    # unsped engine; draft_len bounds the per-step speculation window.
+    serve_spec_decode: str = "off"
+    serve_draft_len: int = 4
+    serve_draft_model: str = ""
 
     def mesh_config(self) -> dict[str, int]:
         return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,
