@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 
@@ -167,6 +168,86 @@ def check_goodput(path: str, min_coverage: float = 0.95,
         report.append("REGRESSION " + line + f" (floor {min_coverage})")
     else:
         report.append("OK " + line)
+    return failures, report
+
+
+def check_slo(path: str):
+    """Gate a serving run's ``slo.jsonl`` (serve/slo.py SLOTracker.flush).
+
+    Well-formedness contract: every line parses; exactly one ``slo_header``
+    (first row) naming the window size; at least one ``slo_window`` row,
+    each with finite quantiles, sample counts within [1, window] (the
+    window-coverage check — a count of 0 means a phantom row, above the
+    window means the deque invariant broke), and attainment in [0, 1];
+    exactly one ``slo_summary`` with finite attainment; and a single
+    run_id across all rows (stale-artifact refusal, same spirit as the
+    goodput mixed-run gate).
+    """
+    failures, report = [], []
+    rows = []
+    try:
+        with open(path) as fh:
+            for i, line in enumerate(fh, 1):
+                if line.strip():
+                    rows.append(json.loads(line))
+    except (OSError, ValueError) as e:
+        msg = f"slo {path}: unreadable or malformed line {len(rows) + 1} ({e})"
+        failures.append(msg)
+        report.append("MALFORMED " + msg)
+        return failures, report
+
+    def fail(msg):
+        failures.append(f"slo {path}: {msg}")
+        report.append(f"MALFORMED slo {path}: {msg}")
+
+    headers = [r for r in rows if r.get("kind") == "slo_header"]
+    windows = [r for r in rows if r.get("kind") == "slo_window"]
+    summaries = [r for r in rows if r.get("kind") == "slo_summary"]
+    if len(headers) != 1 or rows[0] is not headers[0]:
+        fail(f"expected exactly one leading slo_header, got {len(headers)}")
+        return failures, report
+    run_ids = sorted({str(r.get("run_id")) for r in rows})
+    if len(run_ids) > 1:
+        fail(f"rows span {len(run_ids)} run ids {run_ids} — stale "
+             f"artifacts? clear the dir or re-flush")
+        return failures, report
+    window = headers[0].get("window")
+    if not isinstance(window, int) or window < 1:
+        fail(f"header window must be a positive int, got {window!r}")
+        return failures, report
+    if not windows:
+        fail("no slo_window rows (no samples observed?)")
+    for r in windows:
+        key = f"{r.get('replica')}/{r.get('role')}"
+        n_t, n_i = r.get("ttft_count", 0), r.get("itl_count", 0)
+        if not (isinstance(n_t, int) and isinstance(n_i, int)) \
+                or n_t + n_i < 1 or n_t > window or n_i > window:
+            fail(f"window {key}: counts ttft={n_t} itl={n_i} outside "
+                 f"[1, {window}] coverage")
+            continue
+        for metric in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
+                       "itl_p99_ms", "attainment"):
+            v = r.get(metric)
+            if v is not None and not (isinstance(v, (int, float))
+                                      and math.isfinite(v)):
+                fail(f"window {key}: non-finite {metric}={v!r}")
+        att = r.get("attainment")
+        if isinstance(att, (int, float)) and not 0.0 <= att <= 1.0:
+            fail(f"window {key}: attainment {att} outside [0, 1]")
+    if len(summaries) != 1:
+        fail(f"expected exactly one slo_summary, got {len(summaries)}")
+    else:
+        att = summaries[0].get("attainment")
+        if not (isinstance(att, (int, float)) and math.isfinite(att)
+                and 0.0 <= att <= 1.0):
+            fail(f"summary attainment {att!r} not a finite [0, 1] value")
+    if not failures:
+        s = summaries[0]
+        report.append(
+            f"OK slo {path}: run {run_ids[0]}, {len(windows)} window "
+            f"row(s), attainment {s['attainment']}, "
+            f"{s.get('breaches', 0)} breach(es), "
+            f"{s.get('dropped_spans', 0)} dropped span(s)")
     return failures, report
 
 
@@ -331,6 +412,10 @@ def main(argv=None):
                         "(cumulative across supervisor attempts for elastic "
                         "runs); fails below --goodput-min-coverage")
     p.add_argument("--goodput-min-coverage", type=float, default=0.95)
+    p.add_argument("--slo", default=None, metavar="SLO_JSONL",
+                   help="also gate this serving run's slo.jsonl "
+                        "(serve/slo.py): well-formed rows, single run_id, "
+                        "window coverage, finite quantiles")
     p.add_argument("--cluster", action="store_true",
                    help="with --goodput: the file is a fleet "
                         "cluster_goodput.json (launch.py --fleet) — gate "
@@ -394,10 +479,10 @@ def main(argv=None):
         for line in report:
             print(line)
         return 1 if failures else 0
-    # --metrics-jsonl / --goodput alone are standalone scans (no bench row
-    # expected on stdin); a positional result file, or plain piped usage,
-    # still runs the golden comparison.
-    if args.result or not (args.metrics_jsonl or args.goodput):
+    # --metrics-jsonl / --goodput / --slo alone are standalone scans (no
+    # bench row expected on stdin); a positional result file, or plain piped
+    # usage, still runs the golden comparison.
+    if args.result or not (args.metrics_jsonl or args.goodput or args.slo):
         raw = open(args.result).read() if args.result else sys.stdin.read()
         # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result
         # under "parsed") or piped bench.py output (last stdout line is the
@@ -418,6 +503,10 @@ def main(argv=None):
                                              cluster=args.cluster)
         failures += g_failures
         report += g_report
+    if args.slo:
+        s_failures, s_report = check_slo(args.slo)
+        failures += s_failures
+        report += s_report
     for line in report:
         print(line)
     return 1 if failures else 0

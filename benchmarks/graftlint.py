@@ -769,8 +769,8 @@ def _gl004(root: str) -> list[Finding]:
 GL005_PATHS = (f"{PKG}/utils/chaos.py", f"{PKG}/data/sampler.py",
                f"{PKG}/serve/engine.py", f"{PKG}/serve/loadgen.py",
                f"{PKG}/serve/prefix_cache.py", f"{PKG}/serve/router.py",
-               f"{PKG}/serve/spec_decode.py", f"{PKG}/utils/scheduler.py",
-               "launch.py")
+               f"{PKG}/serve/slo.py", f"{PKG}/serve/spec_decode.py",
+               f"{PKG}/utils/scheduler.py", "launch.py")
 _NP_UNSEEDED = {
     "rand",
     "randn",

@@ -123,13 +123,13 @@ def _make_proposer(args):
 
 def _build_engine(module, params, spec, args, *, closed_loop: bool,
                   cached: bool, spec_on: bool = False, telemetry=None,
-                  metrics=None):
+                  metrics=None, reqtrace=None, slo=None):
     from pytorch_distributed_training_example_tpu.serve import engine as engine_lib
 
     kw = dict(decode_buckets=(1,) if closed_loop else args.decode_buckets,
               prompt_buckets=args.prompt_buckets,
               max_model_len=args.max_model_len, telemetry=telemetry,
-              metrics=metrics)
+              metrics=metrics, reqtrace=reqtrace, slo=slo)
     mk = lambda **extra: engine_lib.ContinuousBatchingEngine(
         module, params, spec, **kw, **extra)
     spec_kw = (dict(spec_decode=_make_proposer(args),
@@ -158,13 +158,19 @@ def _parse_chaos(text: str | None) -> tuple[str, int] | None:
 
 def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
               cached: bool = False, spec_on: bool = False, telemetry=None,
-              metrics=None) -> tuple[dict, list]:
-    """One measured phase; returns (summary dict, completed Requests)."""
+              metrics=None, slo=None,
+              reqtrace_factory=None) -> tuple[dict, list]:
+    """One measured phase; returns (summary dict, completed Requests).
+
+    ``slo`` (an SLOTracker) and ``reqtrace_factory`` (replica name ->
+    RequestTrace) instrument the phase's engines with r20 request-level
+    observability — a disaggregated pair shares its replica's tracer."""
     from pytorch_distributed_training_example_tpu.serve import loadgen
 
     submitted = len(requests)
     replicas = 1 if closed_loop else args.replicas
     chaos = None if closed_loop else _parse_chaos(args.chaos_replica)
+    rt_for = reqtrace_factory or (lambda name: None)
     if replicas > 1:
         from pytorch_distributed_training_example_tpu.serve import (
             router as router_lib)
@@ -172,7 +178,8 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
         fleet = {f"replica{i}": _build_engine(
                      module, params, spec, args, closed_loop=closed_loop,
                      cached=cached, spec_on=spec_on, telemetry=telemetry,
-                     metrics=metrics)
+                     metrics=metrics, reqtrace=rt_for(f"replica{i}"),
+                     slo=slo)
                  for i in range(replicas)}
         n_exec = sum(rep.warmup() for rep in fleet.values())
         eng = router_lib.PrefixAffinityRouter(
@@ -181,7 +188,8 @@ def run_phase(module, params, spec, args, requests, *, closed_loop: bool,
         eng = _build_engine(module, params, spec, args,
                             closed_loop=closed_loop, cached=cached,
                             spec_on=spec_on, telemetry=telemetry,
-                            metrics=metrics)
+                            metrics=metrics, reqtrace=rt_for("replica0"),
+                            slo=slo)
         n_exec = eng.warmup()
     chaos_fired = False
     t0 = time.perf_counter()
@@ -583,6 +591,27 @@ def main(argv=None):
                         "export pdtx_serve_* gauges")
     p.add_argument("--trace-dir", default=None,
                    help="write trace_events.json/goodput.json here")
+    p.add_argument("--slo", action="store_true",
+                   help="instrument the saturation phase with per-request "
+                        "span tracing + sliding-window TTFT/ITL quantiles "
+                        "(serve/slo.py); artifacts go to --slo-dir")
+    p.add_argument("--slo-dir", default=None,
+                   help="write slo.jsonl + reqtrace.*.json here "
+                        "(default: --trace-dir)")
+    p.add_argument("--slo-window", type=int, default=256,
+                   help="sliding-window size in samples per replica/role")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="TTFT SLO target in ms (0 = quantiles only)")
+    p.add_argument("--slo-itl-ms", type=float, default=0.0,
+                   help="inter-token-latency SLO target in ms (0 = "
+                        "quantiles only)")
+    p.add_argument("--trace-events", type=int, default=4096,
+                   help="request-span ring capacity per replica")
+    p.add_argument("--trace-overhead", action="store_true",
+                   help="with --slo: run saturation once untraced first, "
+                        "assert greedy token identity traced vs untraced, "
+                        "and report host-side tracing overhead in µs per "
+                        "decode step")
     p.add_argument("--aot", action="store_true",
                    help="emit the chipless AOT decode-step byte model "
                         "instead of running load")
@@ -691,6 +720,29 @@ def main(argv=None):
         result["metrics_port"] = metrics.port
     recorder = tele.SpanRecorder(run_id=f"serve_bench_s{args.seed}")
 
+    # r20 SLO kit: one tracker for the bench, one request-trace ring per
+    # replica of the saturation phase. The run id matches the SpanRecorder
+    # stamp so trace_merge accepts both artifact families as one run.
+    slo_tracker = None
+    tracers: dict = {}
+    reqtrace_factory = None
+    if args.slo:
+        from pytorch_distributed_training_example_tpu.serve import (
+            slo as slo_lib)
+
+        slo_tracker = slo_lib.SLOTracker(
+            window=args.slo_window, ttft_target_ms=args.slo_ttft_ms,
+            itl_target_ms=args.slo_itl_ms)
+
+        def reqtrace_factory(name):
+            rt = slo_lib.RequestTrace(
+                name, run_id=f"serve_bench_s{args.seed}",
+                capacity=args.trace_events)
+            tracers[name] = rt
+            return rt
+    elif args.trace_overhead:
+        raise SystemExit("--trace-overhead needs --slo")
+
     if not args.skip_batch1:
         _say("serve_bench: phase batch1 (closed loop)")
         result["batch1"], _ = run_phase(
@@ -698,12 +750,39 @@ def main(argv=None):
                                                args.seed + 1),
             closed_loop=True, telemetry=recorder, metrics=metrics)
         _say(f"  batch1: {result['batch1']['tokens_per_s_per_chip']} tok/s/chip")
+    if args.trace_overhead:
+        # Baseline for the zero-intrusion contract: the same seeded
+        # stream, tracing OFF. Greedy decode is deterministic per request
+        # regardless of batching interleave, so the traced run below must
+        # reproduce these exact tokens.
+        _say("serve_bench: phase saturation_untraced (overhead baseline)")
+        result["saturation_untraced"], untraced_done = run_phase(
+            module, params, spec, args, mkload(args.rate, args.requests,
+                                               args.seed),
+            closed_loop=False, telemetry=recorder, metrics=metrics)
     _say(f"serve_bench: phase saturation (open loop, rate={args.rate})")
     result["saturation"], base_done = run_phase(
         module, params, spec, args, mkload(args.rate, args.requests,
                                            args.seed),
-        closed_loop=False, telemetry=recorder, metrics=metrics)
+        closed_loop=False, telemetry=recorder, metrics=metrics,
+        slo=slo_tracker, reqtrace_factory=reqtrace_factory)
     sat = result["saturation"]
+    if args.trace_overhead:
+        untraced_by_id = {r.request_id: r.generated for r in untraced_done}
+        for r in base_done:
+            assert r.generated == untraced_by_id[r.request_id], \
+                f"tracing changed tokens for {r.request_id}"
+        ut = result["saturation_untraced"]
+        overhead_us = (sat["wall_s"] - ut["wall_s"]) \
+            / max(sat["decode_steps"], 1) * 1e6
+        result["trace_overhead"] = {
+            "token_identity": "ok",
+            "untraced_wall_s": ut["wall_s"],
+            "traced_wall_s": sat["wall_s"],
+            "decode_steps": sat["decode_steps"],
+            "overhead_us_per_step": round(overhead_us, 2),
+        }
+        _say(f"  trace overhead: {result['trace_overhead']}")
     _say(f"  saturation: {sat['tokens_per_s_per_chip']} tok/s/chip, "
          f"ttft p50/p99 {sat['ttft_ms']['p50']}/{sat['ttft_ms']['p99']} ms, "
          f"itl p50/p99 {sat['inter_token_ms']['p50']}"
@@ -798,6 +877,27 @@ def main(argv=None):
     if args.trace_dir:
         recorder.write(args.trace_dir)
         _say(f"serve_bench: wrote trace/goodput to {args.trace_dir}")
+    if slo_tracker is not None:
+        dropped = sum(rt.dropped_spans for rt in tracers.values())
+        slo_dir = args.slo_dir or args.trace_dir
+        if slo_dir:
+            run_id = f"serve_bench_s{args.seed}"
+            slo_path = slo_tracker.flush(slo_dir, run_id,
+                                         dropped_spans=dropped)
+            for rt in tracers.values():
+                rt.write(slo_dir)
+            _say(f"serve_bench: wrote {slo_path} + {len(tracers)} "
+                 f"reqtrace file(s)")
+        if metrics is not None:
+            metrics.update(**slo_tracker.gauges(extra_dropped=dropped))
+            metrics.update_histograms(**slo_tracker.histograms())
+        result["slo"] = {
+            "run_id": f"serve_bench_s{args.seed}",
+            "attainment": round(slo_tracker.overall_attainment(), 4),
+            "breaches": slo_tracker.breaches,
+            "dropped_spans": dropped,
+            "windows": slo_tracker.snapshot(),
+        }
     if metrics is not None:
         result["metrics_snapshot"] = {
             k: v for k, v in metrics.snapshot().items()

@@ -7,6 +7,9 @@ Inputs (all under one checkpoint/log directory, written by
 - ``trace_events.r<R>.a<A>.json`` (+ legacy plain ``trace_events.json``)
 - ``goodput.r<R>.a<A>.json``      (+ legacy plain ``goodput.json``)
 - ``steprows.r<R>.a<A>.jsonl``    (per-step host timings, log-cadence flushed)
+- ``reqtrace.<replica>.a<A>[.g<N>].json`` — serving request spans
+  (``serve/slo.py``); each replica becomes a ``host/serve:<replica>``
+  track group with per-role lanes (prefill/decode/router)
 
 Outputs:
 
@@ -53,6 +56,10 @@ FLEET_GOODPUT = "fleet_goodput.json"
 
 _TRACE_RE = re.compile(r"trace_events\.r(\d+)\.a(\d+)\.json$")
 _GOODPUT_RE = re.compile(r"goodput\.r(\d+)\.a(\d+)\.json$")
+# Serving request traces (serve/slo.py RequestTrace): per-replica, with
+# optional ring-rotation generations (".g<N>").
+_REQTRACE_RE = re.compile(
+    r"reqtrace\.([A-Za-z0-9_.-]+?)\.a(\d+)(?:\.g(\d+))?\.json$")
 
 
 def load_trace_salvage(path: str) -> dict | None:
@@ -109,6 +116,24 @@ def discover(directory: str) -> dict[tuple[int, int], str]:
     return found
 
 
+def discover_reqtraces(directory: str) -> dict[tuple[str, int, int], str]:
+    """(replica, attempt, generation) -> request-trace path. The live
+    snapshot (no ``.g<N>`` suffix) sorts as generation 2**31 so rotated
+    generations replay in write order before it."""
+    found: dict[tuple[str, int, int], str] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return {}
+    for name in names:
+        m = _REQTRACE_RE.fullmatch(name)
+        if m:
+            gen = int(m.group(3)) if m.group(3) is not None else 2**31
+            found[(m.group(1), int(m.group(2)), gen)] = os.path.join(
+                directory, name)
+    return found
+
+
 def _anchor_wall(doc: dict) -> float | None:
     anchor = (doc.get("otherData") or {}).get("clock_anchor") or {}
     try:
@@ -128,12 +153,22 @@ def merge_traces(directory: str, *, allow_mixed_run: bool = False) -> dict:
                   file=sys.stderr)
             continue
         docs[key] = doc
-    if not docs:
+    req_paths = discover_reqtraces(directory)
+    req_docs: dict[tuple[str, int, int], dict] = {}
+    for key in sorted(req_paths):
+        doc = load_trace_salvage(req_paths[key])
+        if doc is None:
+            print(f"trace_merge: {req_paths[key]} unsalvageable — skipped",
+                  file=sys.stderr)
+            continue
+        req_docs[key] = doc
+    if not docs and not req_docs:
         raise SystemExit(f"trace_merge: no readable trace files in "
                          f"{directory!r}")
 
+    all_docs = list(docs.values()) + list(req_docs.values())
     run_ids = sorted({(d.get("otherData") or {}).get("run_id") or "<unstamped>"
-                      for d in docs.values()})
+                      for d in all_docs})
     if len(run_ids) > 1 and not allow_mixed_run:
         raise SystemExit(
             f"trace_merge: refusing to merge artifacts from {len(run_ids)} "
@@ -142,7 +177,7 @@ def merge_traces(directory: str, *, allow_mixed_run: bool = False) -> dict:
 
     # Wall anchors: earliest one is the merged time origin. Unanchored
     # (legacy) docs sit at offset 0 — their spans still render, unaligned.
-    walls = [w for w in (_anchor_wall(d) for d in docs.values())
+    walls = [w for w in (_anchor_wall(d) for d in all_docs)
              if w is not None]
     origin = min(walls) if walls else 0.0
 
@@ -172,19 +207,69 @@ def merge_traces(directory: str, *, allow_mixed_run: bool = False) -> dict:
                 out["args"] = {**out["args"], "attempt": attempt}
             events.append(out)
 
+    # Serving request traces: one track group per (host, replica), sitting
+    # next to the training ranks. Role lanes (prefill/decode/router) are
+    # the tids RequestTrace stamped; name them from the doc's roles map.
+    serve_pids: dict[str, int] = {}
+    dropped_spans = 0
+    for (replica, attempt, gen), doc in sorted(req_docs.items()):
+        other = doc.get("otherData") or {}
+        host = other.get("host") or "host"
+        label = f"{host}/serve:{replica}"
+        if label not in serve_pids:
+            pid = len(pid_by_group) + len(serve_pids) + 1
+            serve_pids[label] = pid
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": label}})
+            for tid, role in sorted((other.get("roles") or {}).items(),
+                                    key=lambda kv: int(kv[0])):
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": int(tid), "args": {"name": str(role)}})
+        pid = serve_pids[label]
+        try:
+            dropped_spans += int(other.get("dropped_spans") or 0)
+        except (TypeError, ValueError):
+            pass
+        wall = _anchor_wall(doc)
+        shift_us = int(((wall - origin) if wall is not None else 0.0) * 1e6)
+        for ev in doc.get("traceEvents") or []:
+            if not isinstance(ev, dict) or "ts" not in ev:
+                continue
+            out = dict(ev)
+            out["ts"] = int(ev["ts"]) + shift_us
+            out["pid"] = pid
+            if attempt > 1:
+                out["args"] = {**(out.get("args") or {}), "attempt": attempt}
+            events.append(out)
+
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("pid", 0),
                                e.get("ts", 0)))
+    merged_from = {f"r{r}.a{a}": os.path.basename(paths[(r, a)])
+                   for (r, a) in sorted(docs)}
+    for (replica, attempt, gen) in sorted(req_docs):
+        tag = f"serve:{replica}.a{attempt}"
+        if gen != 2**31:
+            tag += f".g{gen}"
+        merged_from[tag] = os.path.basename(
+            req_paths[(replica, attempt, gen)])
+    salvaged = sorted(
+        [f"r{r}.a{a}" for (r, a), d in docs.items() if d.get("_salvaged")]
+        + [f"serve:{rep}.a{a}" + (f".g{g}" if g != 2**31 else "")
+           for (rep, a, g), d in req_docs.items() if d.get("_salvaged")])
     return {
         "otherData": {
             "schema_version": fleetobs.SCHEMA_VERSION,
             "run_ids": run_ids,
-            "merged_from": {f"r{r}.a{a}": os.path.basename(paths[(r, a)])
-                            for (r, a) in sorted(docs)},
-            "track_groups": {f"{h}/rank{r}": pid
-                             for (h, r), pid in pid_by_group.items()},
-            "salvaged": sorted(
-                f"r{r}.a{a}" for (r, a), d in docs.items()
-                if d.get("_salvaged")),
+            "merged_from": merged_from,
+            "track_groups": {
+                **{f"{h}/rank{r}": pid
+                   for (h, r), pid in pid_by_group.items()},
+                **serve_pids,
+            },
+            "salvaged": salvaged,
+            "dropped_spans": dropped_spans,
             "origin_wall": origin,
         },
         "displayTimeUnit": "ms",
@@ -248,6 +333,11 @@ def main(argv: list[str] | None = None) -> int:
     print(f"trace_merge: {trace_path} — {len(merged['traceEvents'])} events, "
           f"{len(groups)} track group(s)"
           + (f", salvaged {salvaged}" if salvaged else ""))
+    dropped = merged["otherData"].get("dropped_spans", 0)
+    if dropped:
+        print(f"trace_merge: WARNING — {dropped} request span(s) were "
+              f"dropped at capture (ring buffer full); raise the trace "
+              f"event capacity", file=sys.stderr)
 
     per_rank = collect_goodput(args.directory)
     if per_rank:

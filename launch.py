@@ -467,6 +467,7 @@ def run_fleet(args) -> int:
         procs[name] = subprocess.Popen([sys.executable, *cmd], env=env,
                                        stdout=logs[name], stderr=logs[name])
 
+    last_obs_push = float("-inf")
     while not _interrupted:
         for name, pr in list(procs.items()):
             rc = pr.poll()
@@ -488,6 +489,20 @@ def run_fleet(args) -> int:
                     pr.send_signal(signal.SIGTERM)
         if metrics is not None:
             metrics.update(**sched.gauges())
+            if now - last_obs_push >= 2.0:
+                # Per-job artifact gauges at a gentle cadence: straggler
+                # flag counts (r12 detection, previously write-only) so a
+                # slow host is scrapeable while the fleet runs.
+                last_obs_push = now
+                for name in sched.jobs:
+                    ckdir = sched.state(name).spec.checkpoint_dir
+                    if not ckdir:
+                        continue
+                    rows = fleetobs.read_jsonl_tolerant(
+                        os.path.join(ckdir, fleetobs.STRAGGLER_FILE))
+                    if rows:
+                        metrics.update(**fleetobs.straggler_gauges(
+                            rows, prefix=f"fleet_straggler_{name}"))
         if sched.finished():
             break
         deadline = sched.next_deadline_s()

@@ -275,6 +275,27 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="serve_draft_model",
                    help="draft model name for --serve-spec-decode draft, "
                         "optionally \"name@ckpt_dir\" to restore its params")
+    p.add_argument("--serve-slo", action="store_true", default=None,
+                   dest="serve_slo",
+                   help="record per-request span traces and sliding-window "
+                        "TTFT/ITL quantiles (serve/slo.py); artifacts land "
+                        "in the checkpoint dir (slo.jsonl, reqtrace.*.json)")
+    p.add_argument("--serve-slo-window", type=int, default=None,
+                   dest="serve_slo_window",
+                   help="sliding-window size in samples per replica/role "
+                        "(default 256)")
+    p.add_argument("--serve-slo-ttft-ms", type=float, default=None,
+                   dest="serve_slo_ttft_ms",
+                   help="TTFT SLO target in ms (0 = track quantiles only)")
+    p.add_argument("--serve-slo-itl-ms", type=float, default=None,
+                   dest="serve_slo_itl_ms",
+                   help="inter-token-latency SLO target in ms (0 = track "
+                        "quantiles only)")
+    p.add_argument("--serve-trace-events", type=int, default=None,
+                   dest="serve_trace_events",
+                   help="request-span ring-buffer capacity per replica; "
+                        "overflow rotates generations and counts "
+                        "dropped_spans (default 4096)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,

@@ -89,6 +89,7 @@ class Request:
     # --- runtime (engine-owned) ---
     generated: list[int] = dataclasses.field(default_factory=list)
     submit_t: float | None = None
+    admit_t: float | None = None
     first_token_t: float | None = None
     token_times: list[float] = dataclasses.field(default_factory=list)
     evictions: int = 0
@@ -163,6 +164,7 @@ class ContinuousBatchingEngine:
                  spec_decode: Any = None,
                  draft_len: int = 4,
                  telemetry=None, metrics=None,
+                 reqtrace=None, slo=None,
                  clock: Callable[[], float] = time.perf_counter):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(f"unknown engine role {role!r}")
@@ -188,6 +190,15 @@ class ContinuousBatchingEngine:
         self.prefill_chunk = int(prefill_chunk)
         self.telemetry = telemetry
         self.metrics = metrics
+        # Request-level observability (serve/slo.py): ``reqtrace`` records
+        # lifecycle span events, ``slo`` accumulates TTFT/ITL windows. Both
+        # ride timestamps this engine already takes (or cheap extra reads
+        # of the same injected host clock) — never a device sync, so
+        # tokens and compile counts are identical with tracing on or off.
+        self.reqtrace = reqtrace
+        self.slo = slo
+        self._slo_key = (reqtrace.replica if reqtrace is not None
+                         else "engine", role)
         self._clock = clock
         self.table_width = pages_for_tokens(self.max_model_len,
                                             spec.page_size)
@@ -470,6 +481,17 @@ class ContinuousBatchingEngine:
             self._lens[slot] = plen
             self.stats["admitted"] += 1
             self.stats["prompt_tokens"] += plen
+            if self.reqtrace is not None:
+                now = self._clock()
+                req.admit_t = now
+                if req.submit_t is not None:
+                    self.reqtrace.span("queue_wait", req.submit_t, now,
+                                       role=self.role,
+                                       request_id=req.request_id)
+                self.reqtrace.instant("admit", now, role=self.role,
+                                      request_id=req.request_id,
+                                      cached_tokens=start,
+                                      recompute=req.evictions > 0)
             if cow_idx is not None:
                 self._cow(slot, cow_idx)
             if self.role == "prefill":
@@ -501,6 +523,9 @@ class ContinuousBatchingEngine:
                     nodes.remove(node)
                     break
         self.stats["cow_copies"] += 1
+        if self.reqtrace is not None:
+            self.reqtrace.instant("cow", role=self.role,
+                                  request_id=req.request_id, page=int(new))
 
     def _window_cap(self) -> int:
         return self.prefill_chunk or self.prompt_buckets[-1]
@@ -537,12 +562,17 @@ class ContinuousBatchingEngine:
                                self.table_width * self.spec.page_size - 1)
         table = self._tables[slot:slot + 1]
         last = np.asarray([n - 1], np.int32)
+        t0 = self._clock() if self.reqtrace is not None else 0.0
         with self._span("prefill"):
             tok, self.cache = step(self.params, self.cache,
                                    jnp.asarray(tokens),
                                    jnp.asarray(positions[None]),
                                    jnp.asarray(table), jnp.asarray(last))
             first = int(np.asarray(tok)[0])
+        if self.reqtrace is not None:
+            self.reqtrace.span("prefill_chunk", t0, self._clock(),
+                               role=self.role, request_id=req.request_id,
+                               pos=pos, n=n)
         return first
 
     def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
@@ -556,6 +586,12 @@ class ContinuousBatchingEngine:
         self._next_tok[slot] = first
         self.stats["prefills"] += 1
         self.stats["tokens_generated"] += 1
+        if self.slo is not None and req.ttft_s is not None:
+            self.slo.observe_ttft(*self._slo_key, req.ttft_s)
+        if self.reqtrace is not None:
+            self.reqtrace.span("prefill", req.admit_t or now, now,
+                               role=self.role, request_id=req.request_id,
+                               tokens=len(req.prompt))
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt, self._pages[slot])
         if self.role == "prefill" and not req.finished(self.max_model_len):
@@ -580,6 +616,10 @@ class ContinuousBatchingEngine:
                                      length=len(req.prompt),
                                      next_token=first))
         self.stats["handoffs_out"] += 1
+        if self.reqtrace is not None:
+            self.reqtrace.instant("kv_handoff", role=self.role,
+                                  request_id=req.request_id,
+                                  pages=len(pages))
         self._release_slot(slot)
 
     def _place(self, handoff: Handoff, slot: int) -> None:
@@ -599,6 +639,10 @@ class ContinuousBatchingEngine:
         self._next_tok[slot] = handoff.next_token
         self.stats["handoffs_in"] += 1
         self.stats["admitted"] += 1
+        if self.reqtrace is not None:
+            self.reqtrace.instant("kv_place", role=self.role,
+                                  request_id=req.request_id,
+                                  pages=handoff.n_pages)
         if self.proposer is not None:
             self.proposer.begin(self, slot, req)
 
@@ -683,8 +727,13 @@ class ContinuousBatchingEngine:
         youngest.generated.clear()
         youngest.token_times.clear()
         youngest.first_token_t = None
+        youngest.admit_t = None
         youngest.evictions += 1
         self.stats["evictions"] += 1
+        if self.reqtrace is not None:
+            self.reqtrace.instant("evict", role=self.role,
+                                  request_id=youngest.request_id,
+                                  evictions=youngest.evictions)
         if self.role == "decode":
             self.requeued.append(youngest)
         else:
@@ -695,6 +744,14 @@ class ContinuousBatchingEngine:
         if req is not None and req.finished(self.max_model_len):
             self._release_slot(slot)
             self.completed.append(req)
+            if self.reqtrace is not None and req.submit_t is not None:
+                end = (req.token_times[-1] if req.token_times
+                       else req.submit_t)
+                self.reqtrace.span("request", req.submit_t, end,
+                                   role=self.role,
+                                   request_id=req.request_id,
+                                   tokens=len(req.generated),
+                                   evictions=req.evictions)
 
     def _span(self, name: str):
         if self.telemetry is not None:
@@ -774,12 +831,15 @@ class ContinuousBatchingEngine:
                 positions[j] = 0
                 table[j] = 0
         step = self._get_step("decode", bucket, 1)
+        t0 = self._clock() if self.reqtrace is not None else 0.0
         with self._span("decode" if self.role == "decode" else "step"):
             tok, self.cache = step(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(table),
                 np.zeros(bucket, np.int32))
-            out = np.asarray(tok)
+            out = np.asarray(tok)    # the step's ONE host sync
+        # All per-request bookkeeping below (ITL samples, span events)
+        # rides this single clock read — tracing adds no syncs.
         now = self._clock()
         self.stats["decode_steps"] += 1
         produced = 0
@@ -787,12 +847,18 @@ class ContinuousBatchingEngine:
             req = self.slots[i]
             if req is None:
                 continue
+            if self.slo is not None and req.token_times:
+                self.slo.observe_itl(*self._slo_key,
+                                     now - req.token_times[-1])
             req.generated.append(int(out[j]))
             req.token_times.append(now)
             self._lens[i] += 1
             self._next_tok[i] = int(out[j])
             produced += 1
             self._retire(i)
+        if self.reqtrace is not None:
+            self.reqtrace.span("decode_step", t0, now, role=self.role,
+                               batch=len(active), produced=produced)
         self.stats["tokens_generated"] += produced
         return produced
 
@@ -852,16 +918,20 @@ class ContinuousBatchingEngine:
             tok_dev = tok_dev.at[:len(active), 1:1 + values.shape[1]].set(
                 values.astype(jnp.int32))
         step = self._get_step("verify", bucket, width)
+        t0 = self._clock() if self.reqtrace is not None else 0.0
         with self._span("decode" if self.role == "decode" else "step"):
             out, self.cache = step(
                 self.params, self.cache, tok_dev,
                 jnp.asarray(positions), jnp.asarray(table),
                 np.zeros(bucket, np.int32))
             fetched = np.asarray(out)    # [bucket, 2, width]: scores, echo
+        # One host sync per verify step, same as plain decode; all span/SLO
+        # bookkeeping below reads the fetched array + this one clock value.
         now = self._clock()
         self.stats["decode_steps"] += 1
         self.stats["spec_steps"] += 1
         produced = 0
+        step_drafted = step_accepted = 0
         for j, i in enumerate(rows):
             req = self.slots[i]
             if req is None:
@@ -873,20 +943,35 @@ class ContinuousBatchingEngine:
                 n_acc += 1
             # Emit accepted drafts + the bonus token one at a time, exactly
             # like the unsped loop would — an eos mid-acceptance truncates.
+            prev_t = req.token_times[-1] if req.token_times else None
+            emitted = 0
             for t in [int(x) for x in echoed[1:1 + n_acc]] \
                     + [int(scored[n_acc])]:
                 req.generated.append(t)
                 req.token_times.append(now)
                 self._lens[i] += 1
                 produced += 1
+                emitted += 1
                 if req.finished(self.max_model_len):
                     break
+            if self.slo is not None and prev_t is not None and emitted:
+                # A verify step emits a burst sharing one timestamp; the
+                # honest per-token latency is the step gap amortized over
+                # the burst (one sample per request per step).
+                self.slo.observe_itl(*self._slo_key,
+                                     (now - prev_t) / emitted)
             self._next_tok[i] = req.generated[-1]
             self.stats["draft_tokens"] += k
             self.stats["accepted_tokens"] += n_acc
             self.stats[f"spec_accept_{n_acc}"] += 1
+            step_drafted += k
+            step_accepted += n_acc
             self._rollback(i)
             self._retire(i)
+        if self.reqtrace is not None:
+            self.reqtrace.span("spec_verify", t0, now, role=self.role,
+                               batch=len(active), drafted=step_drafted,
+                               accepted=step_accepted, produced=produced)
         self.stats["tokens_generated"] += produced
         return produced
 
@@ -1015,6 +1100,12 @@ class DisaggregatedServe:
     @property
     def prefix_cache(self):
         return self.prefill_engine.prefix_cache
+
+    @property
+    def reqtrace(self):
+        """The pair shares one RequestTrace (built per replica); role tids
+        keep the prefill and decode lanes apart inside it."""
+        return self.prefill_engine.reqtrace
 
     def prefix_hit_rate(self) -> float:
         return self.prefill_engine.prefix_hit_rate()

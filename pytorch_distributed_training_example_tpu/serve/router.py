@@ -106,10 +106,22 @@ class PrefixAffinityRouter:
         return choice
 
     def submit(self, req: Request) -> None:
+        hits_before = self.stats["affinity_hits"]
         name = self.route(req)
         self._placed[req.request_id] = name
         self._replicas[name].engine.submit(req)
         self.stats["routed"] += 1
+        self._trace(name, "router_admit", req,
+                    affinity_hit=self.stats["affinity_hits"] > hits_before)
+
+    def _trace(self, name: str, event: str, req: Request, **args) -> None:
+        """Stamp a routing decision onto the CHOSEN replica's request
+        trace (serve/slo.py), if that replica records one. Host-side
+        bookkeeping only — the router never touches device state."""
+        rt = getattr(self._replicas[name].engine, "reqtrace", None)
+        if rt is not None:
+            rt.instant(event, role="router", request_id=req.request_id,
+                       replica=name, **args)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -119,11 +131,13 @@ class PrefixAffinityRouter:
         req.generated.clear()
         req.token_times.clear()
         req.first_token_t = None
+        req.admit_t = None
         req.evictions += 1
         name = self.route(req)
         self._placed[req.request_id] = name
         self._replicas[name].engine.submit(req)
         self.stats["rerouted"] += 1
+        self._trace(name, "router_reroute", req, evictions=req.evictions)
 
     def drain(self, name: str) -> int:
         """SIGTERM semantics: stop placements, re-route the queue, let

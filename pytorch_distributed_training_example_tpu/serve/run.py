@@ -18,7 +18,8 @@ import time
 from pytorch_distributed_training_example_tpu.utils import resilience
 
 
-def serve_loop(driver, eng, drain_timeout_s: float = 5.0) -> dict:
+def serve_loop(driver, eng, drain_timeout_s: float = 5.0,
+               tick=None) -> dict:
     """Drive the open-loop stream until drained — or gracefully shut down.
 
     When a SIGTERM lands (``resilience.preempted()``, handler installed by
@@ -29,10 +30,18 @@ def serve_loop(driver, eng, drain_timeout_s: float = 5.0) -> dict:
     the in-flight work, then exit ``PREEMPTED_EXIT_CODE`` — which is what
     makes serving jobs preemptible by the fleet scheduler
     (``launch.py --fleet``) with nothing worse than truncated tail latency.
+
+    ``tick``, when given, runs every 128 iterations — the SLO observability
+    hook (flush slo.jsonl, push gauges, rotate request-trace rings). It is
+    host-side bookkeeping only; it must never touch device state.
     """
     t0 = time.perf_counter()
     drain_deadline = None
+    it = 0
     while driver.remaining or eng.has_work:
+        it += 1
+        if tick is not None and it % 128 == 0:
+            tick()
         if drain_deadline is None and resilience.preempted():
             drain_deadline = time.perf_counter() + drain_timeout_s
         if drain_deadline is not None:
@@ -86,6 +95,31 @@ def main(cfg) -> dict:
 
         metrics = fleetobs.MetricsServer(port=cfg.metrics_port).start()
 
+    # r20 SLO observability: one SLOTracker for the session, one
+    # RequestTrace ring per replica (a disaggregated pair shares its
+    # replica's tracer — role lanes keep prefill/decode apart). The
+    # run id is deterministic (seed-derived fallback) so same-seed runs
+    # produce byte-identical slo.jsonl headers.
+    slo_tracker = None
+    tracers: dict[str, object] = {}
+    run_id = ""
+    flightrec = None
+    if cfg.serve_slo:
+        from pytorch_distributed_training_example_tpu.serve import (
+            slo as slo_lib)
+        from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+        run_id = fleetobs.ensure_run_id(cfg.checkpoint_dir or "",
+                                        f"serve_s{cfg.seed}")
+        slo_tracker = slo_lib.SLOTracker(
+            window=cfg.serve_slo_window,
+            ttft_target_ms=cfg.serve_slo_ttft_ms,
+            itl_target_ms=cfg.serve_slo_itl_ms)
+        if cfg.checkpoint_dir:
+            flightrec = fleetobs.FlightRecorder()
+            fleetobs.set_active(flightrec, cfg.checkpoint_dir,
+                                meta={"mode": "serve", "run_id": run_id})
+
     spec = engine_lib.spec_for_module(module, num_pages=cfg.serve_num_pages,
                                       page_size=cfg.serve_page_size)
     buckets = lambda s: tuple(int(t) for t in s.split(",") if t)
@@ -120,7 +154,7 @@ def main(cfg) -> dict:
         return spec_decode_lib.DraftModelProposer(
             draft.module, dparams, draft_len=cfg.serve_draft_len)
 
-    def build_replica():
+    def build_replica(name: str = "replica0"):
         """One serve replica: a single engine, or a prefill/decode pair
         under --serve-disaggregate. All replicas share module + params
         (one process, one set of weights) but own separate page pools."""
@@ -128,6 +162,14 @@ def main(cfg) -> dict:
                   prompt_buckets=buckets(cfg.serve_prompt_buckets),
                   max_model_len=cfg.serve_max_model_len or None,
                   metrics=metrics)
+        if slo_tracker is not None:
+            from pytorch_distributed_training_example_tpu.serve import (
+                slo as slo_lib)
+
+            rt = slo_lib.RequestTrace(name, run_id=run_id,
+                                      capacity=cfg.serve_trace_events)
+            tracers[name] = rt
+            kw.update(reqtrace=rt, slo=slo_tracker)
         spec_kw = dict(spec_decode=build_proposer(),
                        draft_len=cfg.serve_draft_len)
         if cfg.serve_disaggregate:
@@ -146,7 +188,7 @@ def main(cfg) -> dict:
         from pytorch_distributed_training_example_tpu.serve import (
             router as router_lib)
 
-        replicas = {f"replica{i}": build_replica()
+        replicas = {f"replica{i}": build_replica(f"replica{i}")
                     for i in range(cfg.serve_replicas)}
         for rep in replicas.values():
             rep.warmup()
@@ -182,8 +224,41 @@ def main(cfg) -> dict:
     # no-op off the main thread (in-process tests drive serve_loop directly).
     resilience.install()
     driver = loadgen.OpenLoopDriver(requests)
+
+    slo_tick = None
+    if slo_tracker is not None:
+        tick_count = [0]
+
+        def slo_tick():
+            """Periodic host-side SLO bookkeeping (serve_loop, every 128
+            iterations): flush the window file, push live gauges, rotate
+            rings nearing capacity, dump the flight recorder on a fresh
+            breach episode. Never touches device state."""
+            tick_count[0] += 1
+            dropped = sum(rt.dropped_spans for rt in tracers.values())
+            if cfg.checkpoint_dir:
+                slo_tracker.flush(cfg.checkpoint_dir, run_id,
+                                  dropped_spans=dropped)
+                for rt in tracers.values():
+                    if rt.pending >= (rt.capacity * 3) // 4:
+                        rt.rotate(cfg.checkpoint_dir)
+            if metrics is not None:
+                metrics.update(**slo_tracker.gauges(extra_dropped=dropped))
+                metrics.update_histograms(**slo_tracker.histograms())
+            if flightrec is not None:
+                flightrec.record_timing(
+                    tick_count[0],
+                    attainment=round(slo_tracker.overall_attainment(), 4),
+                    breaches=slo_tracker.breaches, dropped_spans=dropped)
+            breach = slo_tracker.breach()
+            if breach is not None:
+                fleetobs.dump_active(
+                    f"slo_breach:{breach}",
+                    attainment=slo_tracker.overall_attainment())
+
     outcome = serve_loop(driver, eng,
-                         drain_timeout_s=cfg.serve_drain_timeout)
+                         drain_timeout_s=cfg.serve_drain_timeout,
+                         tick=slo_tick)
     wall = outcome["wall_s"]
 
     completed = eng.completed
@@ -233,6 +308,31 @@ def main(cfg) -> dict:
             "accepted_len_hist": {
                 n: stats.get(f"spec_accept_{n}", 0)
                 for n in range(cfg.serve_draft_len + 1)},
+        }
+    if slo_tracker is not None:
+        # Final breach check + artifact flush: slo.jsonl (atomic) plus one
+        # request-trace snapshot per replica, all under the checkpoint dir
+        # where trace_merge.py and the fleet scheduler look for them.
+        breach = slo_tracker.breach()
+        if breach is not None:
+            fleetobs.dump_active(
+                f"slo_breach:{breach}",
+                attainment=slo_tracker.overall_attainment())
+        dropped = sum(rt.dropped_spans for rt in tracers.values())
+        if cfg.checkpoint_dir:
+            slo_tracker.flush(cfg.checkpoint_dir, run_id,
+                              dropped_spans=dropped)
+            for rt in tracers.values():
+                rt.write(cfg.checkpoint_dir)
+        if metrics is not None:
+            metrics.update(**slo_tracker.gauges(extra_dropped=dropped))
+            metrics.update_histograms(**slo_tracker.histograms())
+        result["slo"] = {
+            "run_id": run_id,
+            "attainment": round(slo_tracker.overall_attainment(), 4),
+            "breaches": slo_tracker.breaches,
+            "dropped_spans": dropped,
+            "windows": slo_tracker.snapshot(),
         }
     if cfg.serve_disaggregate:
         result["handoffs"] = stats["handoffs_out"]

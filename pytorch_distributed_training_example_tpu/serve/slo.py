@@ -1,0 +1,418 @@
+"""Request-level serving observability: span traces + sliding-window SLOs.
+
+Two host-side recorders, both zero-intrusion by construction — they only
+consume timestamps the engine already takes (one injected-clock read per
+decode step, one per admission) plus the token ids that come back through
+the engine's single ``np.asarray`` fetch. Nothing here touches the device,
+so compile counts and emitted tokens are identical with tracing on or off
+(asserted in tests/test_slo.py and dryrun leg 20).
+
+- :class:`RequestTrace`: a bounded ring of request-lifecycle span events
+  (router admit, queue wait, prefill, KV handoff, per-step decode, spec
+  verify, COW/eviction) emitted as a Perfetto-compatible per-replica trace
+  file ``reqtrace.<replica>.a<attempt>.json`` that ``benchmarks/
+  trace_merge.py`` aligns next to the training-rank tracks. The ring plus
+  generation rotation (``rotate``) bounds artifact growth on long open-loop
+  runs; wrapping is LOUD — ``dropped_spans`` counts every evicted event and
+  is stamped into the file header and the ``/metrics`` gauges.
+- :class:`SLOTracker`: sliding-window p50/p99 TTFT and inter-token latency
+  per (replica, role), clock-injected like ``utils/scheduler.py`` so tests
+  are deterministic. Snapshots export as gauges + cumulative Prometheus
+  histograms on the existing ``MetricsServer`` and flush atomically to
+  ``slo.jsonl`` — the file ``FleetScheduler.plan`` reads to fold SLO
+  attainment into a serve job's placement weight, and the file
+  ``check_regression.py --slo`` gates in CI.
+
+Quantiles use the same linear interpolation as ``numpy.percentile``'s
+default so the tests can diff against a numpy reference exactly.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import math
+import os
+import time
+from typing import Callable
+
+from pytorch_distributed_training_example_tpu.utils import fleetobs
+
+log = logging.getLogger("pdtx")
+
+#: slo.jsonl lives in the serve job's checkpoint directory — the same place
+#: the scheduler already reads goodput.json from — so the placement loop
+#: needs no new plumbing to find it. The name (and the attainment reader)
+#: live in stdlib fleetobs so the jax-free scheduler/launcher never import
+#: the serve package.
+SLO_FILE = fleetobs.SLO_FILE
+
+#: Cumulative histogram bucket upper bounds, milliseconds (Prometheus
+#: ``le`` convention; ``+Inf`` is implicit as the final bucket).
+HIST_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0, 2500.0)
+
+
+def quantile(samples, q: float) -> float | None:
+    """q-th percentile (0..100) with numpy's default linear interpolation.
+
+    Pure stdlib so the SLO path needs no numpy at import; the test suite
+    asserts exact agreement with ``np.percentile(samples, q)``.
+    """
+    xs = sorted(samples)
+    if not xs:
+        return None
+    if len(xs) == 1:
+        return float(xs[0])
+    pos = (len(xs) - 1) * (float(q) / 100.0)
+    lo = math.floor(pos)
+    hi = min(math.ceil(pos), len(xs) - 1)
+    frac = pos - lo
+    return float(xs[lo]) * (1.0 - frac) + float(xs[hi]) * frac
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace: bounded per-replica span ring -> Perfetto trace files
+# ---------------------------------------------------------------------------
+
+#: Stable thread ids per engine role so a replica's prefill and decode
+#: lanes render as separate named tracks under one process group.
+ROLE_TIDS = {"both": 0, "prefill": 1, "decode": 2, "router": 3}
+
+
+class RequestTrace:
+    """Bounded ring of request-lifecycle events for ONE serve replica.
+
+    Events carry timestamps from the caller's injected monotonic clock (the
+    engine hands in the ``now`` it already took after its decode fetch); the
+    wall/monotonic anchor captured at construction lets the merge CLI align
+    this replica's track with every other host's, exactly like
+    ``SpanRecorder``. When the ring is full the OLDEST event is dropped and
+    ``dropped_spans`` increments — silently growing files on long open-loop
+    runs is the failure mode this replaces, so the drop is by design loud:
+    warned once, stamped in the file header, exported as a gauge.
+    """
+
+    def __init__(self, replica: str, *, role: str = "both", run_id: str = "",
+                 capacity: int = 4096, max_generations: int = 4,
+                 clock: Callable[[], float] = time.perf_counter,
+                 wall_clock: Callable[[], float] = time.time):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.replica = str(replica)
+        self.role = role
+        self.run_id = run_id
+        self.capacity = int(capacity)
+        self.max_generations = int(max_generations)
+        self._clock = clock
+        self._anchor_mono = clock()
+        self._anchor_wall = wall_clock()
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self.dropped_spans = 0
+        self._generation = 0
+        self._warned = False
+
+    # ------------------------------------------------------------ recording
+
+    @property
+    def pending(self) -> int:
+        return len(self._events)
+
+    def event(self, name: str, t0: float, dur_s: float = 0.0, *,
+              role: str | None = None, **args) -> None:
+        """One span (``dur_s > 0``) or instant (``dur_s == 0``) event at
+        injected-clock time ``t0``. Never blocks, never syncs."""
+        if len(self._events) == self.capacity:
+            self.dropped_spans += 1
+            if not self._warned:
+                self._warned = True
+                log.warning(
+                    "reqtrace[%s]: span ring full (capacity=%d) — dropping "
+                    "oldest events; rotate() more often or raise "
+                    "--serve-trace-events", self.replica, self.capacity)
+        self._events.append((name, role or self.role, t0, dur_s, args))
+
+    def instant(self, name: str, t: float | None = None, *,
+                role: str | None = None, **args) -> None:
+        self.event(name, self._clock() if t is None else t, 0.0,
+                   role=role, **args)
+
+    def span(self, name: str, t0: float, t1: float, *,
+             role: str | None = None, **args) -> None:
+        self.event(name, t0, max(t1 - t0, 0.0), role=role, **args)
+
+    # -------------------------------------------------------------- emitting
+
+    def trace_events(self) -> dict:
+        """Perfetto/Chrome trace doc, ``otherData`` first (same torn-write
+        salvage contract as ``SpanRecorder.trace_events``)."""
+        events = []
+        for name, role, t0, dur_s, args in self._events:
+            ev = {"name": name,
+                  "ph": "X" if dur_s > 0 else "i",
+                  "cat": "serve",
+                  "ts": int((t0 - self._anchor_mono) * 1e6),
+                  "pid": 0,
+                  "tid": ROLE_TIDS.get(role, 7)}
+            if dur_s > 0:
+                ev["dur"] = int(dur_s * 1e6)
+            else:
+                ev["s"] = "t"
+            if args:
+                ev["args"] = {k: v for k, v in args.items()}
+            events.append(ev)
+        return fleetobs.trace_doc(
+            run_id=self.run_id,
+            anchor_wall=self._anchor_wall, anchor_mono=self._anchor_mono,
+            events=events,
+            meta={"replica": self.replica, "role": self.role,
+                  "host": fleetobs.host_identity(),
+                  "dropped_spans": self.dropped_spans,
+                  "generation": self._generation,
+                  "roles": {str(v): k for k, v in ROLE_TIDS.items()}})
+
+    def _path(self, directory: str, attempt: int, gen: int | None) -> str:
+        g = "" if gen is None else f".g{gen}"
+        return os.path.join(directory,
+                            f"reqtrace.{self.replica}.a{attempt}{g}.json")
+
+    def write(self, directory: str, attempt: int = 1) -> str:
+        """Final snapshot (ring is kept): ``reqtrace.<replica>.a<N>.json``."""
+        os.makedirs(directory, exist_ok=True)
+        path = self._path(directory, attempt, None)
+        fleetobs.write_json_atomic(path, self.trace_events())
+        return path
+
+    def rotate(self, directory: str, attempt: int = 1) -> str:
+        """Flush the ring to the next generation file and clear it, keeping
+        at most ``max_generations`` on disk — the cap that bounds artifact
+        growth on long open-loop runs (satellite of r20)."""
+        os.makedirs(directory, exist_ok=True)
+        path = self._path(directory, attempt, self._generation)
+        fleetobs.write_json_atomic(path, self.trace_events())
+        self._events.clear()
+        stale = self._generation - self.max_generations
+        self._generation += 1
+        if stale >= 0:
+            try:
+                os.unlink(self._path(directory, attempt, stale))
+            except OSError:
+                pass
+        return path
+
+
+# ---------------------------------------------------------------------------
+# SLOTracker: sliding-window TTFT/ITL quantiles + attainment
+# ---------------------------------------------------------------------------
+
+
+class _Window:
+    __slots__ = ("ttft", "itl")
+
+    def __init__(self, window: int):
+        self.ttft: collections.deque = collections.deque(maxlen=window)
+        self.itl: collections.deque = collections.deque(maxlen=window)
+
+
+class _Hist:
+    """Cumulative (never-evicted) histogram in Prometheus bucket form."""
+
+    __slots__ = ("counts", "total", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(HIST_BUCKETS_MS) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def add(self, ms: float) -> None:
+        for i, le in enumerate(HIST_BUCKETS_MS):
+            if ms <= le:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.total += ms
+        self.count += 1
+
+    def render(self) -> dict:
+        cum, out = 0, []
+        for le, c in zip(HIST_BUCKETS_MS, self.counts):
+            cum += c
+            out.append((le, cum))
+        out.append(("+Inf", self.count))
+        return {"buckets": out, "sum": round(self.total, 3),
+                "count": self.count}
+
+
+class SLOTracker:
+    """Sliding-window p50/p99 TTFT + ITL per (replica, role).
+
+    Windows are sample-count sliding (``deque(maxlen=window)``) — eviction
+    keeps the quantiles responsive to the CURRENT load regime instead of
+    averaging over the whole run. Targets of 0 disable attainment/breach
+    accounting (attainment reports 1.0). The clock is injected and only
+    used for breach-episode bookkeeping, never for sample values — callers
+    pass in latencies they measured themselves, which is what keeps this
+    module out of the engine's host-sync budget.
+    """
+
+    def __init__(self, *, window: int = 256, ttft_target_ms: float = 0.0,
+                 itl_target_ms: float = 0.0, min_breach_samples: int = 8,
+                 clock: Callable[[], float] = time.perf_counter):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.ttft_target_ms = float(ttft_target_ms)
+        self.itl_target_ms = float(itl_target_ms)
+        self.min_breach_samples = int(min_breach_samples)
+        self._clock = clock
+        self._windows: dict[tuple[str, str], _Window] = {}
+        self._hists: dict[tuple[str, str, str], _Hist] = {}
+        self._in_breach = False
+        self.breaches = 0
+
+    # ------------------------------------------------------------ observing
+
+    def _win(self, replica: str, role: str) -> _Window:
+        key = (str(replica), str(role))
+        if key not in self._windows:
+            self._windows[key] = _Window(self.window)
+        return self._windows[key]
+
+    def observe_ttft(self, replica: str, role: str, seconds: float) -> None:
+        ms = float(seconds) * 1e3
+        self._win(replica, role).ttft.append(ms)
+        self._hists.setdefault((replica, role, "ttft"), _Hist()).add(ms)
+
+    def observe_itl(self, replica: str, role: str, seconds: float) -> None:
+        ms = float(seconds) * 1e3
+        self._win(replica, role).itl.append(ms)
+        self._hists.setdefault((replica, role, "itl"), _Hist()).add(ms)
+
+    # ------------------------------------------------------------ reporting
+
+    @staticmethod
+    def _ok(samples, target_ms: float) -> tuple[int, int]:
+        if target_ms <= 0 or not samples:
+            return len(samples), len(samples)
+        return sum(1 for s in samples if s <= target_ms), len(samples)
+
+    def snapshot(self) -> dict:
+        """Per-(replica, role) window stats keyed ``"replica/role"``."""
+        out = {}
+        for (replica, role), w in sorted(self._windows.items()):
+            ok_t, n_t = self._ok(w.ttft, self.ttft_target_ms)
+            ok_i, n_i = self._ok(w.itl, self.itl_target_ms)
+            total = n_t + n_i
+            out[f"{replica}/{role}"] = {
+                "replica": replica, "role": role,
+                "ttft_count": n_t, "itl_count": n_i,
+                "ttft_p50_ms": quantile(w.ttft, 50),
+                "ttft_p99_ms": quantile(w.ttft, 99),
+                "itl_p50_ms": quantile(w.itl, 50),
+                "itl_p99_ms": quantile(w.itl, 99),
+                "attainment": (ok_t + ok_i) / total if total else 1.0,
+            }
+        return out
+
+    def overall_attainment(self) -> float:
+        """Pooled in-target fraction across every window — the scalar the
+        fleet scheduler quantizes into a serve job's placement weight."""
+        ok = n = 0
+        for w in self._windows.values():
+            ok_t, n_t = self._ok(w.ttft, self.ttft_target_ms)
+            ok_i, n_i = self._ok(w.itl, self.itl_target_ms)
+            ok += ok_t + ok_i
+            n += n_t + n_i
+        return ok / n if n else 1.0
+
+    def breach(self) -> str | None:
+        """Episode-gated breach check: returns a reason string on the FIRST
+        check where some window's p99 exceeds its target (with at least
+        ``min_breach_samples`` samples), then stays quiet until every
+        window has recovered — the same episode semantics as
+        ``telemetry.AnomalyGuard`` so one bad stretch produces one
+        FlightRecorder dump, not one per step."""
+        bad = []
+        for (replica, role), w in sorted(self._windows.items()):
+            for metric, samples, target in (
+                    ("ttft", w.ttft, self.ttft_target_ms),
+                    ("itl", w.itl, self.itl_target_ms)):
+                if target <= 0 or len(samples) < self.min_breach_samples:
+                    continue
+                p99 = quantile(samples, 99)
+                if p99 is not None and p99 > target:
+                    bad.append(f"{replica}/{role}:{metric}_p99="
+                               f"{p99:.1f}ms>{target:g}ms")
+        if not bad:
+            self._in_breach = False
+            return None
+        if self._in_breach:
+            return None
+        self._in_breach = True
+        self.breaches += 1
+        return "slo_breach:" + ",".join(bad)
+
+    def gauges(self, extra_dropped: int = 0) -> dict:
+        """Flat gauge dict for ``MetricsServer.update`` (names are
+        sanitized by the server; ``/`` becomes ``_``)."""
+        out = {"serve_slo_attainment": round(self.overall_attainment(), 4),
+               "serve_slo_breaches": self.breaches,
+               "serve_slo_dropped_spans": extra_dropped}
+        for key, snap in self.snapshot().items():
+            for metric in ("ttft_p50_ms", "ttft_p99_ms",
+                           "itl_p50_ms", "itl_p99_ms"):
+                v = snap[metric]
+                if v is not None:
+                    out[f"serve_slo_{metric}_{key}"] = round(v, 3)
+        return out
+
+    def histograms(self) -> dict:
+        """Cumulative histograms for ``MetricsServer.update_histograms``."""
+        return {f"serve_slo_{metric}_ms_{replica}_{role}": h.render()
+                for (replica, role, metric), h in sorted(self._hists.items())}
+
+    # -------------------------------------------------------------- slo.jsonl
+
+    def rows(self, run_id: str, dropped_spans: int = 0) -> list[dict]:
+        """Header + per-window + summary rows (the ``check_regression
+        --slo`` contract: one run_id, finite quantiles, window coverage)."""
+        rows = [{"schema_version": fleetobs.SCHEMA_VERSION,
+                 "kind": "slo_header", "run_id": run_id,
+                 "window": self.window,
+                 "ttft_target_ms": self.ttft_target_ms,
+                 "itl_target_ms": self.itl_target_ms}]
+        for snap in self.snapshot().values():
+            if snap["ttft_count"] + snap["itl_count"] == 0:
+                continue
+            row = {"kind": "slo_window", "run_id": run_id}
+            row.update({k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in snap.items() if v is not None})
+            rows.append(row)
+        rows.append({"kind": "slo_summary", "run_id": run_id,
+                     "attainment": round(self.overall_attainment(), 4),
+                     "windows": len(self._windows),
+                     "breaches": self.breaches,
+                     "dropped_spans": dropped_spans})
+        return rows
+
+    def flush(self, directory: str, run_id: str,
+              dropped_spans: int = 0) -> str:
+        """Atomically (re)write ``slo.jsonl`` — tmp + ``os.replace``, same
+        torn-write discipline as ``fleetobs.write_json_atomic``, so the
+        scheduler never reads a half-written window row."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, SLO_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            for row in self.rows(run_id, dropped_spans):
+                fh.write(json.dumps(row, default=float) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+#: Reader lives in fleetobs (stdlib) so the scheduler/launcher can consume
+#: slo.jsonl without importing the serve package; re-exported here for the
+#: serving-side callers that already import this module.
+read_attainment = fleetobs.read_slo_attainment

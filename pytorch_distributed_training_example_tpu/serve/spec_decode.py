@@ -79,6 +79,8 @@ class NGramProposer:
 
     def propose(self, engine, active: list[int],
                 budgets: dict[int, int]) -> tuple[dict[int, int], dict]:
+        rt = engine.reqtrace
+        t0 = engine._clock() if rt is not None else 0.0
         counts: dict[int, int] = {}
         values: dict[int, list[int]] = {}
         for i in active:
@@ -87,6 +89,10 @@ class NGramProposer:
                             min(budgets[i], self.draft_len))
             counts[i] = len(d)
             values[i] = d
+        if rt is not None:
+            rt.span("draft_propose", t0, engine._clock(), role=engine.role,
+                    proposer="ngram", slots=len(active),
+                    drafted=sum(counts.values()))
         return counts, values
 
     def _match(self, ctx: list[int], k: int) -> list[int]:
@@ -257,6 +263,8 @@ class DraftModelProposer:
 
     def propose(self, engine, active: list[int],
                 budgets: dict[int, int]) -> tuple[dict[int, int], Any]:
+        rt = engine.reqtrace
+        t0 = engine._clock() if rt is not None else 0.0
         counts = {i: min(int(budgets[i]), self.draft_len) for i in active}
         k_max = max(counts.values(), default=0)
         if k_max == 0:
@@ -300,6 +308,12 @@ class DraftModelProposer:
             cur = cur[:, None]
             drafts.append(cur)
         values = jnp.concatenate(drafts, axis=1)[:len(active)]
+        if rt is not None:
+            # Drafts stay on device — this span times the HOST-side
+            # dispatch of the draft chain, not a fetch (no sync added).
+            rt.span("draft_propose", t0, engine._clock(), role=engine.role,
+                    proposer="draft_model", slots=len(active),
+                    drafted=sum(counts.values()))
         return counts, values
 
 

@@ -168,6 +168,17 @@ class Config:
     serve_spec_decode: str = "off"
     serve_draft_len: int = 4
     serve_draft_model: str = ""
+    # r20 serving SLO observability (serve/slo.py): per-request span
+    # tracing (reqtrace.<replica>.a<A>.json, merged by trace_merge.py)
+    # plus a sliding-window TTFT/ITL quantile tracker flushed to
+    # slo.jsonl, which the fleet scheduler folds into serve-job
+    # placement weights. Targets of 0 ms disable attainment/breach
+    # accounting (quantiles still export).
+    serve_slo: bool = False
+    serve_slo_window: int = 256       # samples per replica/role window
+    serve_slo_ttft_ms: float = 0.0    # TTFT target; 0 = no target
+    serve_slo_itl_ms: float = 0.0     # per-token ITL target; 0 = no target
+    serve_trace_events: int = 4096    # request-span ring capacity/replica
 
     def mesh_config(self) -> dict[str, int]:
         return dict(data=self.mesh_data, fsdp=self.mesh_fsdp, stage=self.mesh_stage,

@@ -42,6 +42,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import math
 import os
 import re
 import socket
@@ -58,6 +59,10 @@ SCHEMA_VERSION = 1
 RUN_ID_FILE = "run_id.json"
 PROGRESS_FILE = "progress.json"
 STRAGGLER_FILE = "straggler.jsonl"
+#: Sliding-window serving SLO summary (serve/slo.py), written atomically
+#: into the serve job's checkpoint dir; the fleet scheduler folds its
+#: attainment into placement weights.
+SLO_FILE = "slo.jsonl"
 
 #: Step-row components attributed by the straggler detector. ``input_wait``
 #: and ``checkpoint`` are host-local causes; ``compute`` is the residual
@@ -166,6 +171,45 @@ def write_json_atomic(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
+def read_slo_attainment(path: str) -> float | None:
+    """Last ``slo_summary`` attainment from a serve job's ``slo.jsonl``
+    (written atomically by ``serve.slo.SLOTracker.flush``), or None.
+
+    Lives here — not in the serve package — so the jax-free fleet
+    scheduler and launcher can fold SLO attainment into placement without
+    importing serving code. Tolerant of torn tails like every fleet reader.
+    """
+    att = None
+    for row in read_jsonl_tolerant(path):
+        if row.get("kind") == "slo_summary":
+            try:
+                a = float(row["attainment"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if math.isfinite(a):
+                att = min(max(a, 0.0), 1.0)
+    return att
+
+
+def trace_doc(*, run_id: str, anchor_wall: float, anchor_mono: float,
+              events: list, meta: dict | None = None) -> dict:
+    """Assemble a Perfetto/Chrome trace document with the repo's salvage
+    contract: ``otherData`` (identity stamps + clock anchor) deliberately
+    comes FIRST — json.dump preserves insertion order, so a file torn
+    mid-write by a killed host loses trailing *events*, never the header
+    the merge CLI needs to salvage the prefix. Shared by the training-side
+    ``telemetry.SpanRecorder`` and the serving-side ``serve.slo
+    .RequestTrace`` so both merge under one clock-alignment rule."""
+    return {"otherData": {
+                "schema_version": SCHEMA_VERSION,
+                "run_id": run_id,
+                **(meta or {}),
+                "clock_anchor": {"wall": anchor_wall,
+                                 "monotonic": anchor_mono}},
+            "displayTimeUnit": "ms",
+            "traceEvents": list(events)}
+
+
 def write_progress(directory: str, payload: dict) -> str:
     """Atomically replace ``progress.json`` (rank 0, log cadence)."""
     path = os.path.join(directory, PROGRESS_FILE)
@@ -268,6 +312,39 @@ def write_stragglers(directory: str, rows: list[dict]) -> str:
         for row in rows:
             fh.write(json.dumps(row, default=float) + "\n")
     return path
+
+
+def straggler_gauges(rows: list[dict], prefix: str = "fleet_straggler"
+                     ) -> dict[str, float]:
+    """Fold ``straggler.jsonl`` rows into live Prometheus gauges.
+
+    r12 detection has been write-only since it landed; this makes it
+    scrapeable at runtime (``launch.py --fleet`` pushes the result onto the
+    fleet MetricsServer every poll cadence). Per-rank flag counts stand in
+    for per-host counts — in this fleet each child process IS a host, and
+    ``slowest_rank`` is the only locator the rows carry.
+    """
+    out: dict[str, float] = {f"{prefix}_steps": float(len(rows)),
+                             f"{prefix}_flagged_total": 0.0}
+    worst = 0.0
+    for row in rows:
+        if not row.get("flagged"):
+            continue
+        out[f"{prefix}_flagged_total"] += 1
+        rank = row.get("slowest_rank")
+        if rank is not None:
+            key = f"{prefix}_flagged_rank{int(rank)}"
+            out[key] = out.get(key, 0.0) + 1
+        cause = str(row.get("cause") or "unknown")
+        key = f"{prefix}_cause_{_METRIC_RE.sub('_', cause)}"
+        out[key] = out.get(key, 0.0) + 1
+        try:
+            worst = max(worst, float(row.get("delta_s") or 0.0))
+        except (TypeError, ValueError):
+            pass
+    if out[f"{prefix}_flagged_total"]:
+        out[f"{prefix}_worst_delta_s"] = round(worst, 4)
+    return out
 
 
 class StragglerMonitor:
@@ -485,6 +562,7 @@ class MetricsServer:
         self.port: int | None = None
         self._gauges: dict[str, float] = {}
         self._info: dict[str, str] = {}
+        self._hists: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._httpd = None
         self._thread: threading.Thread | None = None
@@ -499,10 +577,21 @@ class MetricsServer:
                 else:
                     self._info[_METRIC_RE.sub("_", str(key))] = str(val)
 
+    def update_histograms(self, **hists) -> None:
+        """Cumulative Prometheus histograms (serving SLO latencies). Each
+        value is ``{"buckets": [(le, cum_count), ..., ("+Inf", n)],
+        "sum": float, "count": int}`` — the shape
+        ``serve.slo.SLOTracker.histograms`` emits."""
+        with self._lock:
+            for key, val in hists.items():
+                if val:
+                    self._hists[_METRIC_RE.sub("_", str(key))] = val
+
     def render(self) -> str:
         with self._lock:
             gauges = dict(self._gauges)
             info = dict(self._info)
+            hists = dict(self._hists)
         lines = []
         if info:
             labels = ",".join(f'{k}="{v}"' for k, v in sorted(info.items()))
@@ -517,6 +606,14 @@ class MetricsServer:
             else:
                 text = repr(val)
             lines += [f"# TYPE pdtx_{key} gauge", f"pdtx_{key} {text}"]
+        for key in sorted(hists):
+            h = hists[key]
+            lines.append(f"# TYPE pdtx_{key} histogram")
+            for le, cum in h.get("buckets", ()):
+                le_s = le if isinstance(le, str) else repr(float(le))
+                lines.append(f'pdtx_{key}_bucket{{le="{le_s}"}} {int(cum)}')
+            lines += [f"pdtx_{key}_sum {float(h.get('sum', 0.0))!r}",
+                      f"pdtx_{key}_count {int(h.get('count', 0))}"]
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict:

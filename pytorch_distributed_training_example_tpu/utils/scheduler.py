@@ -54,6 +54,7 @@ import os
 import re
 
 from pytorch_distributed_training_example_tpu.utils import elastic
+from pytorch_distributed_training_example_tpu.utils import fleetobs
 from pytorch_distributed_training_example_tpu.utils import resilience
 
 #: Decision log, one JSON row per scheduling action, in the fleet log dir.
@@ -143,6 +144,11 @@ class JobState:
     next_eligible_s: float = 0.0   # backoff deadline (monotonic clock)
     last_exit: int | None = None
     weight: float = 1.0            # quantized goodput fraction
+    #: Quantized sliding-window SLO attainment (serve jobs only; 1.0 until
+    #: the job's slo.jsonl reports otherwise). Multiplies ``weight`` in the
+    #: D'Hondt quotient — a replica missing its latency targets bids for
+    #: surplus devices at a discount, it is never starved below MIN.
+    slo_attainment: float = 1.0
 
     @property
     def name(self) -> str:
@@ -287,6 +293,8 @@ class FleetScheduler:
             st = self.jobs[name]
             out[f"fleet_job_world_{name}"] = st.world
             out[f"fleet_job_restarts_{name}"] = st.restarts
+            if st.spec.kind == "serve":
+                out[f"fleet_job_slo_attainment_{name}"] = st.slo_attainment
         return out
 
     # ------------------------------------------------------------ internals
@@ -354,6 +362,13 @@ class FleetScheduler:
         ``preempt`` (SIGTERM). Deterministic given job states and ``now_s``.
         """
         decisions: list[dict] = []
+        # Serving SLO feedback (ROADMAP item 6): refresh each serve job's
+        # sliding-window attainment from its atomically-replaced slo.jsonl
+        # before weighing the surplus. Quantized like goodput, so the
+        # placement log stays byte-reproducible for a given set of files.
+        for st in self.jobs.values():
+            if st.spec.kind == "serve":
+                self._refresh_slo(st)
         eligible = self._eligible(now_s)
         incoming = sum(st.world for st in self.jobs.values()
                        if st.status == PREEMPTING)
@@ -406,14 +421,16 @@ class FleetScheduler:
                 # The candidate launches on a later pass, once the victims'
                 # emergency checkpoints are written and their devices free.
             # Surplus within the tier: D'Hondt highest averages, weighted
-            # by quantized goodput, capped per job.
+            # by quantized goodput times quantized SLO attainment (serve
+            # jobs; 1.0 for trainers), capped per job.
             while avail > 0:
                 best = None
                 best_score = (-1.0, "")
                 for st in launched:
                     if st.world >= self._cap(st):
                         continue
-                    score = (st.weight / (st.world + 1), st.name)
+                    score = (st.weight * st.slo_attainment / (st.world + 1),
+                             st.name)
                     # Higher quotient wins; name ascending breaks ties.
                     if best is None or score[0] > best_score[0] or (
                             score[0] == best_score[0]
@@ -498,3 +515,17 @@ class FleetScheduler:
             st.weight = quantize_weight(doc["goodput_fraction"])
         except (OSError, ValueError, KeyError, TypeError):
             pass  # a torn goodput file must not stall scheduling
+
+    def _refresh_slo(self, st: JobState) -> None:
+        """Serve jobs: quantized attainment from the job's slo.jsonl.
+
+        The file is atomically replaced by the serving loop (never torn)
+        and ``read_slo_attainment`` is tolerant of anything else; absence
+        (job not started, SLO tracking off) leaves the neutral 1.0."""
+        ckdir = st.spec.checkpoint_dir
+        if not ckdir:
+            return
+        att = fleetobs.read_slo_attainment(
+            os.path.join(ckdir, fleetobs.SLO_FILE))
+        if att is not None:
+            st.slo_attainment = quantize_weight(att)

@@ -237,18 +237,12 @@ class SpanRecorder:
         return time.perf_counter() - self._start
 
     def trace_events(self) -> dict:
-        # ``otherData`` (identity stamps + clock anchor) deliberately comes
-        # FIRST: json.dump preserves insertion order, so a file torn mid-write
-        # by a killed host loses trailing *events*, never the header the
-        # merge CLI needs to salvage the prefix.
-        return {"otherData": {
-                    "schema_version": fleetobs.SCHEMA_VERSION,
-                    "run_id": self.run_id,
-                    **self.meta,
-                    "clock_anchor": {"wall": self._wall_origin,
-                                     "monotonic": self._start}},
-                "displayTimeUnit": "ms",
-                "traceEvents": list(self._events)}
+        # ``fleetobs.trace_doc`` puts otherData FIRST (torn-write salvage
+        # contract) and is shared with the serving-side RequestTrace so both
+        # kinds of file merge under one clock-alignment rule.
+        return fleetobs.trace_doc(
+            run_id=self.run_id, anchor_wall=self._wall_origin,
+            anchor_mono=self._start, events=self._events, meta=self.meta)
 
     def goodput(self) -> dict:
         """Wall-clock decomposition since construction (plus carried attempts).

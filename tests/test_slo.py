@@ -1,0 +1,490 @@
+"""Serving SLO observability (serve/slo.py + the r20 consumers).
+
+Three layers under test, mirroring the module split:
+
+- math: ``quantile`` against the numpy reference, sliding-window eviction,
+  attainment accounting, episode-gated breach detection — all stdlib,
+  clock-injected, jax-free;
+- artifacts: the request-trace ring (drop counting, rotation caps,
+  otherData-first torn-write contract), atomic ``slo.jsonl`` flush, the
+  ``check_regression --slo`` gate, and ``trace_merge`` folding reqtrace
+  files into the fleet trace;
+- consumers: MetricsServer histogram rendering under concurrent scrapes,
+  the fleet scheduler's quantized SLO placement weight (byte-reproducible
+  plans), and — the one jax test — the zero-intrusion contract on the real
+  engine: tracing ON changes neither tokens nor compile count.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.serve import slo as slo_lib
+from pytorch_distributed_training_example_tpu.utils import fleetobs
+from pytorch_distributed_training_example_tpu.utils import (
+    scheduler as scheduler_lib)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import check_regression  # noqa: E402
+import trace_merge  # noqa: E402
+
+RUN = "run-slo-test"
+
+
+# ---------------------------------------------------------------------------
+# quantile + window math vs the numpy reference
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_matches_numpy():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 3, 7, 50, 256):
+        xs = rng.standard_normal(n).tolist()
+        for q in (0, 25, 50, 90, 99, 100):
+            assert slo_lib.quantile(xs, q) == pytest.approx(
+                float(np.percentile(np.asarray(xs), q)), abs=1e-12), (n, q)
+
+
+def test_quantile_degenerate_inputs():
+    assert slo_lib.quantile([], 50) is None
+    assert slo_lib.quantile([4.25], 99) == 4.25
+
+
+def test_window_eviction_keeps_current_regime():
+    """Sample-count sliding window: after heavy eviction the quantiles
+    describe only the most recent ``window`` samples."""
+    t = slo_lib.SLOTracker(window=8)
+    for i in range(100):  # 92 evicted; survivors are 92..99 ms
+        t.observe_itl("r0", "both", i * 1e-3)
+    snap = t.snapshot()["r0/both"]
+    tail = np.arange(92, 100, dtype=np.float64)
+    assert snap["itl_count"] == 8
+    assert snap["itl_p50_ms"] == pytest.approx(float(np.percentile(tail, 50)))
+    assert snap["itl_p99_ms"] == pytest.approx(float(np.percentile(tail, 99)))
+    assert t.snapshot()["r0/both"]["ttft_count"] == 0
+
+
+def test_attainment_counts_in_target_fraction():
+    t = slo_lib.SLOTracker(window=16, ttft_target_ms=100.0,
+                           itl_target_ms=10.0)
+    for ms in (50, 150):  # one TTFT in target, one out
+        t.observe_ttft("r0", "both", ms * 1e-3)
+    for ms in (5, 5, 5, 50):  # three ITL in target, one out
+        t.observe_itl("r0", "both", ms * 1e-3)
+    assert t.snapshot()["r0/both"]["attainment"] == pytest.approx(4 / 6)
+    assert t.overall_attainment() == pytest.approx(4 / 6)
+    # No targets -> everything counts as attained.
+    free = slo_lib.SLOTracker(window=16)
+    free.observe_ttft("r0", "both", 10.0)
+    assert free.overall_attainment() == 1.0
+    # No samples at all -> vacuous 1.0 (the scheduler's neutral weight).
+    assert slo_lib.SLOTracker(window=4).overall_attainment() == 1.0
+
+
+def test_breach_is_episode_gated():
+    t = slo_lib.SLOTracker(window=4, itl_target_ms=10.0,
+                           min_breach_samples=4, clock=lambda: 0.0)
+    for _ in range(3):  # below min_breach_samples: never fires
+        t.observe_itl("r0", "both", 0.050)
+        assert t.breach() is None
+    t.observe_itl("r0", "both", 0.050)
+    reason = t.breach()
+    assert reason is not None and "r0/both:itl_p99" in reason
+    assert t.breach() is None  # same episode stays quiet
+    for _ in range(4):  # window recovers -> episode re-arms
+        t.observe_itl("r0", "both", 0.001)
+    assert t.breach() is None
+    for _ in range(4):
+        t.observe_itl("r0", "both", 0.050)
+    assert t.breach() is not None
+    assert t.breaches == 2
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace ring: drops, rotation cap, otherData-first salvage contract
+# ---------------------------------------------------------------------------
+
+
+def _fixed_clocks():
+    return dict(clock=lambda: 12.0, wall_clock=lambda: 1000.0)
+
+
+def test_request_trace_ring_counts_drops():
+    rt = slo_lib.RequestTrace("replica0", run_id=RUN, capacity=4,
+                              **_fixed_clocks())
+    for i in range(7):
+        rt.instant(f"e{i}", t=12.0 + i)
+    assert rt.dropped_spans == 3 and rt.pending == 4
+    names = [e["name"] for e in rt.trace_events()["traceEvents"]]
+    assert names == ["e3", "e4", "e5", "e6"]  # oldest evicted first
+    assert rt.trace_events()["otherData"]["dropped_spans"] == 3
+
+
+def test_request_trace_rotation_caps_generations(tmp_path):
+    rt = slo_lib.RequestTrace("replica0", run_id=RUN, capacity=8,
+                              max_generations=2, **_fixed_clocks())
+    d = str(tmp_path)
+    for gen in range(4):
+        rt.span("work", 12.0, 12.001, request_id=f"g{gen}")
+        rt.rotate(d)
+        assert rt.pending == 0  # rotation clears the ring
+    names = sorted(n for n in os.listdir(d) if n.startswith("reqtrace."))
+    # Generations 0 and 1 were unlinked by the max_generations=2 cap.
+    assert names == ["reqtrace.replica0.a1.g2.json",
+                     "reqtrace.replica0.a1.g3.json"]
+    rt.instant("tail", t=12.5)
+    final = rt.write(d)
+    assert os.path.basename(final) == "reqtrace.replica0.a1.json"
+    # Torn-write salvage contract: otherData must be the FIRST key so a
+    # truncated file keeps its header (trace_merge.load_trace_salvage).
+    raw = open(final).read()
+    assert raw.index('"otherData"') < raw.index('"traceEvents"')
+    assert trace_merge.load_trace_salvage(final)["otherData"]["run_id"] == RUN
+
+
+def test_request_trace_role_lanes():
+    rt = slo_lib.RequestTrace("replica0", run_id=RUN, **_fixed_clocks())
+    rt.instant("admit", t=12.0, role="prefill")
+    rt.span("decode_step", 12.0, 12.001, role="decode")
+    rt.instant("router_admit", t=12.0, role="router")
+    tids = {e["name"]: e["tid"] for e in rt.trace_events()["traceEvents"]}
+    assert tids == {"admit": slo_lib.ROLE_TIDS["prefill"],
+                    "decode_step": slo_lib.ROLE_TIDS["decode"],
+                    "router_admit": slo_lib.ROLE_TIDS["router"]}
+
+
+# ---------------------------------------------------------------------------
+# slo.jsonl: flush atomicity surface + the check_regression --slo gate
+# ---------------------------------------------------------------------------
+
+
+def _sampled_tracker():
+    t = slo_lib.SLOTracker(window=8, ttft_target_ms=100.0, itl_target_ms=10.0)
+    for i in range(12):
+        t.observe_ttft("replica0", "both", 0.020 + i * 1e-3)
+        t.observe_itl("replica0", "both", 0.004)
+    t.observe_itl("replica1", "both", 0.002)
+    return t
+
+
+def test_flush_and_gate_round_trip(tmp_path):
+    t = _sampled_tracker()
+    path = t.flush(str(tmp_path), RUN, dropped_spans=2)
+    rows = [json.loads(line) for line in open(path)]
+    assert rows[0]["kind"] == "slo_header" and rows[0]["window"] == 8
+    assert rows[-1]["kind"] == "slo_summary"
+    assert rows[-1]["dropped_spans"] == 2
+    assert {r["kind"] for r in rows[1:-1]} == {"slo_window"}
+    failures, report = check_regression.check_slo(path)
+    assert not failures, report
+    assert any(line.startswith("OK slo") for line in report)
+    # The scheduler-side reader agrees with the summary row.
+    assert fleetobs.read_slo_attainment(path) == rows[-1]["attainment"]
+
+
+@pytest.mark.parametrize("mutate, expect", [
+    (lambda rows: rows[1:], "slo_header"),              # missing header
+    (lambda rows: [rows[0], rows[-1]], "no slo_window"),
+    (lambda rows: [dict(r, run_id="other") if r["kind"] == "slo_summary"
+                   else r for r in rows], "run ids"),
+    (lambda rows: [dict(r, ttft_p99_ms=float("nan"))
+                   if r["kind"] == "slo_window" else r
+                   for r in rows], "non-finite"),
+    (lambda rows: [dict(r, itl_count=999) if r["kind"] == "slo_window"
+                   else r for r in rows], "coverage"),
+    (lambda rows: rows + [rows[-1]], "slo_summary"),    # duplicate summary
+])
+def test_gate_rejects_malformed_slo(tmp_path, mutate, expect):
+    rows = _sampled_tracker().rows(RUN)
+    path = os.path.join(str(tmp_path), "slo.jsonl")
+    with open(path, "w") as fh:
+        for row in mutate(rows):
+            fh.write(json.dumps(row) + "\n")
+    failures, _ = check_regression.check_slo(path)
+    assert failures and expect in failures[0], failures
+
+
+def test_read_slo_attainment_is_tolerant(tmp_path):
+    assert fleetobs.read_slo_attainment(
+        os.path.join(str(tmp_path), "absent.jsonl")) is None
+    path = os.path.join(str(tmp_path), "slo.jsonl")
+    with open(path, "w") as fh:
+        fh.write("not json at all\n")
+        fh.write(json.dumps({"kind": "slo_summary", "attainment": 7.0}) + "\n")
+    assert fleetobs.read_slo_attainment(path) == 1.0  # clamped into [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# MetricsServer: histogram rendering + concurrent scrape safety
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_histogram_rendering():
+    srv = fleetobs.MetricsServer(port=0, addr="127.0.0.1").start()
+    try:
+        t = _sampled_tracker()
+        srv.update(**t.gauges(extra_dropped=1))
+        srv.update_histograms(**t.histograms())
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+        assert "pdtx_serve_slo_attainment" in text
+        assert "pdtx_serve_slo_dropped_spans 1.0" in text
+        assert ("# TYPE pdtx_serve_slo_ttft_ms_replica0_both histogram"
+                in text)
+        assert 'pdtx_serve_slo_ttft_ms_replica0_both_bucket{le="+Inf"} 12' \
+            in text
+        assert "pdtx_serve_slo_ttft_ms_replica0_both_count 12" in text
+        assert "pdtx_serve_slo_ttft_ms_replica0_both_sum" in text
+    finally:
+        srv.stop()
+
+
+def test_metrics_server_concurrent_scrapes_during_updates():
+    """N writer threads hammer gauges + histograms while M readers scrape
+    /metrics — every response must parse cleanly (no torn renders, no
+    server-thread exceptions)."""
+    srv = fleetobs.MetricsServer(port=0, addr="127.0.0.1").start()
+    errors: list[Exception] = []
+    stop = threading.Event()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def writer(seed):
+            t = slo_lib.SLOTracker(window=32, ttft_target_ms=50.0)
+            i = 0
+            while not stop.is_set():
+                t.observe_ttft(f"r{seed}", "both", (i % 40) * 1e-3)
+                try:
+                    srv.update(**t.gauges())
+                    srv.update_histograms(**t.histograms())
+                except Exception as e:  # pragma: no cover - failure path
+                    errors.append(e)
+                    return
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    text = urllib.request.urlopen(
+                        f"{base}/metrics", timeout=5).read().decode()
+                    for line in text.splitlines():
+                        assert line.startswith(("#", "pdtx_")), line
+                except Exception as e:  # pragma: no cover - failure path
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(3)]
+        threads += [threading.Thread(target=reader) for _ in range(4)]
+        for th in threads:
+            th.start()
+        # Let them contend for a fixed number of scrapes' worth of time.
+        for _ in range(25):
+            urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        assert not errors, errors
+    finally:
+        stop.set()
+        srv.stop()
+
+
+def test_straggler_gauges_shape():
+    rows = [
+        {"step": 1, "flagged": False},
+        {"step": 2, "flagged": True, "slowest_rank": 1, "cause": "input_wait",
+         "delta_s": 0.5},
+        {"step": 3, "flagged": True, "slowest_rank": 1, "cause": "compute",
+         "delta_s": 1.25},
+    ]
+    g = fleetobs.straggler_gauges(rows, prefix="fleet_straggler_job0")
+    assert g["fleet_straggler_job0_steps"] == 3.0
+    assert g["fleet_straggler_job0_flagged_total"] == 2.0
+    assert g["fleet_straggler_job0_flagged_rank1"] == 2.0
+    assert g["fleet_straggler_job0_cause_input_wait"] == 1.0
+    assert g["fleet_straggler_job0_worst_delta_s"] == 1.25
+    # Quiet fleet: no worst-delta gauge, zero flags.
+    quiet = fleetobs.straggler_gauges([{"step": 1, "flagged": False}])
+    assert quiet["fleet_straggler_flagged_total"] == 0.0
+    assert "fleet_straggler_worst_delta_s" not in quiet
+
+
+# ---------------------------------------------------------------------------
+# Fleet scheduler: quantized SLO attainment in the placement weight
+# ---------------------------------------------------------------------------
+
+
+def _fleet(tmp_path, attainment):
+    ckdir = os.path.join(str(tmp_path), "srv_ck")
+    os.makedirs(ckdir, exist_ok=True)
+    if attainment is not None:
+        t = slo_lib.SLOTracker(window=8, itl_target_ms=10.0)
+        n_ok = round(attainment * 8)
+        for i in range(8):
+            t.observe_itl("r0", "both", 0.001 if i < n_ok else 0.100)
+        t.flush(ckdir, RUN)
+    doc = {"pool": 8, "jobs": [
+        {"name": "train", "cmd": ["x"], "world": "2:8", "priority": 1},
+        {"name": "srv", "cmd": ["x", "--checkpoint-dir", ckdir],
+         "world": "2:8", "priority": 1, "kind": "serve"},
+    ]}
+    path = os.path.join(str(tmp_path), "jobs.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    pool, specs = scheduler_lib.load_jobs(path)
+    return scheduler_lib.FleetScheduler(pool, specs)
+
+
+def test_scheduler_degraded_serve_job_loses_devices(tmp_path):
+    """D'Hondt with the SLO factor: a serve job attaining 50% gets fewer
+    devices than the equal-priority trainer; a healthy one splits evenly."""
+    healthy = _fleet(tmp_path / "a", 1.0)
+    worlds = {d["job"]: d["world"] for d in healthy.plan(0.0)}
+    assert worlds["train"] == worlds["srv"] == 4
+    assert healthy.state("srv").slo_attainment == 1.0
+
+    degraded = _fleet(tmp_path / "b", 0.5)
+    worlds = {d["job"]: d["world"] for d in degraded.plan(0.0)}
+    assert degraded.state("srv").slo_attainment == 0.5
+    assert worlds["train"] > worlds["srv"] >= 2
+    assert "fleet_job_slo_attainment_srv" in degraded.gauges()
+
+
+def test_scheduler_plan_byte_reproducible_with_slo(tmp_path):
+    a = _fleet(tmp_path / "x", 0.7).plan(0.0)
+    b = _fleet(tmp_path / "x", 0.7).plan(0.0)  # same dir, same slo.jsonl
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_scheduler_ignores_missing_or_stale_slo(tmp_path):
+    sched = _fleet(tmp_path, None)  # no slo.jsonl at all
+    sched.plan(0.0)
+    assert sched.state("srv").slo_attainment == 1.0  # neutral default
+
+
+# ---------------------------------------------------------------------------
+# trace_merge: reqtrace files join the fleet trace as serve track groups
+# ---------------------------------------------------------------------------
+
+
+def _write_rank_trace(d, run_id=RUN):
+    doc = {"otherData": {"schema_version": fleetobs.SCHEMA_VERSION,
+                         "run_id": run_id, "host": "hostA", "rank": 0,
+                         "clock_anchor": {"wall": 1000.0, "monotonic": 0.0}},
+           "displayTimeUnit": "ms",
+           "traceEvents": [{"name": "step", "cat": "span", "ph": "X",
+                            "ts": 100, "dur": 800, "pid": 0, "tid": 1}]}
+    with open(os.path.join(d, "trace_events.r0.a1.json"), "w") as fh:
+        json.dump(doc, fh)
+
+
+def _write_reqtrace(d, replica, *, wall, run_id=RUN, rotate_first=False):
+    rt = slo_lib.RequestTrace(replica, run_id=run_id, capacity=16,
+                              clock=lambda: 0.0, wall_clock=lambda: wall)
+    if rotate_first:
+        rt.span("decode_step", 0.0, 0.001, role="decode")
+        rt.rotate(d)
+    rt.span("request", 0.0, 0.010, request_id="r1")
+    rt.instant("router_admit", t=0.0, role="router", request_id="r1")
+    rt.write(d)
+
+
+def test_trace_merge_folds_reqtraces_into_fleet_trace(tmp_path):
+    d = str(tmp_path)
+    _write_rank_trace(d)
+    _write_reqtrace(d, "replica0", wall=1000.0, rotate_first=True)
+    _write_reqtrace(d, "replica1", wall=1002.5)  # 2.5 s of wall skew
+    merged = trace_merge.merge_traces(d)
+    groups = merged["otherData"]["track_groups"]
+    assert "hostA/rank0" in groups
+    serve_groups = [g for g in groups if "/serve:" in g]
+    assert len(serve_groups) == 2
+    assert merged["otherData"]["run_ids"] == [RUN]
+    tags = set(merged["otherData"]["merged_from"])
+    assert {"r0.a1", "serve:replica0.a1", "serve:replica0.a1.g0",
+            "serve:replica1.a1"} <= tags
+    # Role lanes are named via thread_name metadata on the serve pids.
+    lanes = {(e["pid"], e["args"]["name"])
+             for e in merged["traceEvents"] if e["name"] == "thread_name"}
+    for g in serve_groups:
+        assert (groups[g], "router") in lanes
+    # replica1's wall skew shifted its events onto the shared axis.
+    by_pid = {}
+    for e in merged["traceEvents"]:
+        if e.get("cat") == "serve" and e["name"] == "request":
+            by_pid[e["pid"]] = e["ts"]
+    pid0 = groups[[g for g in serve_groups if "replica0" in g][0]]
+    pid1 = groups[[g for g in serve_groups if "replica1" in g][0]]
+    assert by_pid[pid1] - by_pid[pid0] == int(2.5e6)
+
+
+def test_trace_merge_refuses_mixed_run_reqtrace(tmp_path):
+    d = str(tmp_path)
+    _write_rank_trace(d)
+    _write_reqtrace(d, "replica0", wall=1000.0, run_id="other-run")
+    with pytest.raises(SystemExit, match="different runs"):
+        trace_merge.merge_traces(d)
+    merged = trace_merge.merge_traces(d, allow_mixed_run=True)
+    assert len(merged["otherData"]["run_ids"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# The zero-intrusion contract on the real engine (the one jax test here).
+# ---------------------------------------------------------------------------
+
+
+def test_engine_tracing_zero_intrusion(devices):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_example_tpu.models import registry
+    from pytorch_distributed_training_example_tpu.serve import (
+        engine as engine_lib)
+
+    bundle = registry.create_model("llama_tiny", seq_len=128,
+                                   dtype=jnp.float32, param_dtype=jnp.float32)
+    module = bundle.module
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                         train=False)["params"]
+    spec = engine_lib.spec_for_module(module, num_pages=32, page_size=8)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 512, plen).tolist() for plen in (3, 8, 9, 23)]
+
+    def run(reqtrace=None, slo=None):
+        eng = engine_lib.ContinuousBatchingEngine(
+            module, params, spec, decode_buckets=(1, 2, 4),
+            prompt_buckets=(16, 32), max_model_len=64,
+            reqtrace=reqtrace, slo=slo)
+        n = eng.warmup()
+        for i, prompt in enumerate(prompts):
+            eng.submit(engine_lib.Request(request_id=f"r{i}", prompt=prompt,
+                                          max_new_tokens=12))
+        done = {r.request_id: r.generated for r in eng.run()}
+        return done, eng.stats["compiles"], n
+
+    base, base_compiles, n_exec = run()
+    rt = slo_lib.RequestTrace("replica0", run_id=RUN)
+    tracker = slo_lib.SLOTracker(window=64, ttft_target_ms=1e9,
+                                 itl_target_ms=1e9)
+    traced, traced_compiles, _ = run(reqtrace=rt, slo=tracker)
+
+    assert traced == base                      # token identity
+    assert traced_compiles == base_compiles == n_exec  # compile count flat
+    events = rt.trace_events()["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"queue_wait", "admit", "prefill", "decode_step",
+            "request"} <= names
+    assert all(e.get("dur", 0) >= 0 for e in events)
+    assert rt.dropped_spans == 0
+    snap = tracker.snapshot()["replica0/both"]
+    assert snap["ttft_count"] == 4
+    assert snap["itl_count"] == sum(len(g) for g in base.values()) - 4
+    assert tracker.overall_attainment() == 1.0
