@@ -171,6 +171,56 @@ def check_goodput(path: str, min_coverage: float = 0.95,
     return failures, report
 
 
+def check_ttfs(path: str, max_ratio: float = 0.8):
+    """Gate warm-restart time-to-first-step against cold (goodput.json).
+
+    The executable cache (core/xcache.py) exists to make restarts fast; this
+    gate keeps that property from silently rotting. ``ttfs_history`` (one
+    entry per attempt, carried across supervisor restarts by the telemetry
+    merge) is split by mode: every ``warm`` attempt must beat the SLOWEST
+    ``cold`` attempt by at least ``max_ratio`` (warm < max_ratio * cold).
+
+    Neutral by design when there is nothing to compare: a run whose cache
+    was missing, corrupted (quarantined -> cold recompile) or never
+    populated has no warm entries — that is the cache layer behaving
+    correctly, not a regression, so the gate reports OK and moves on. An
+    unreadable goodput.json still fails loudly, same as --goodput.
+    """
+    failures, report = [], []
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+        history = list(data.get("ttfs_history") or [])
+    except (OSError, ValueError, AttributeError, TypeError) as e:
+        msg = f"ttfs {path}: unreadable or malformed ({e})"
+        failures.append(msg)
+        report.append("MALFORMED " + msg)
+        return failures, report
+    try:
+        cold = [float(h["ttfs_s"]) for h in history if h.get("mode") == "cold"]
+        warm = [float(h["ttfs_s"]) for h in history if h.get("mode") == "warm"]
+    except (ValueError, KeyError, TypeError) as e:
+        msg = f"ttfs {path}: malformed ttfs_history entry ({e})"
+        failures.append(msg)
+        report.append("MALFORMED " + msg)
+        return failures, report
+    if not warm or not cold:
+        report.append(
+            f"OK ttfs {path}: no warm/cold pair to compare "
+            f"({len(cold)} cold, {len(warm)} warm attempt(s)) — neutral")
+        return failures, report
+    worst_warm, worst_cold = max(warm), min(cold)
+    line = (f"ttfs {path}: warm {worst_warm:.3f}s vs cold {worst_cold:.3f}s "
+            f"(x{worst_warm / worst_cold:.2f}, floor x{max_ratio}) over "
+            f"{len(cold)} cold / {len(warm)} warm attempt(s)")
+    if worst_warm >= max_ratio * worst_cold:
+        failures.append(line + " — executable cache is not paying for itself")
+        report.append("REGRESSION " + line)
+    else:
+        report.append("OK " + line)
+    return failures, report
+
+
 def check_slo(path: str):
     """Gate a serving run's ``slo.jsonl`` (serve/slo.py SLOTracker.flush).
 
@@ -412,6 +462,14 @@ def main(argv=None):
                         "(cumulative across supervisor attempts for elastic "
                         "runs); fails below --goodput-min-coverage")
     p.add_argument("--goodput-min-coverage", type=float, default=0.95)
+    p.add_argument("--ttfs", default=None, metavar="GOODPUT_JSON",
+                   help="also gate warm-restart time-to-first-step from "
+                        "this goodput.json's ttfs_history: every warm "
+                        "(executable-cache hit) attempt must come in under "
+                        "--ttfs-max-ratio of the slowest cold compile; "
+                        "neutral when the run has no warm/cold pair "
+                        "(missing or quarantined cache = cold-only = OK)")
+    p.add_argument("--ttfs-max-ratio", type=float, default=0.8)
     p.add_argument("--slo", default=None, metavar="SLO_JSONL",
                    help="also gate this serving run's slo.jsonl "
                         "(serve/slo.py): well-formed rows, single run_id, "
@@ -482,7 +540,8 @@ def main(argv=None):
     # --metrics-jsonl / --goodput / --slo alone are standalone scans (no
     # bench row expected on stdin); a positional result file, or plain piped
     # usage, still runs the golden comparison.
-    if args.result or not (args.metrics_jsonl or args.goodput or args.slo):
+    if args.result or not (args.metrics_jsonl or args.goodput or args.slo
+                           or args.ttfs):
         raw = open(args.result).read() if args.result else sys.stdin.read()
         # Accept a driver BENCH_r{N}.json wrapper (pretty-printed, result
         # under "parsed") or piped bench.py output (last stdout line is the
@@ -503,6 +562,10 @@ def main(argv=None):
                                              cluster=args.cluster)
         failures += g_failures
         report += g_report
+    if args.ttfs:
+        t_failures, t_report = check_ttfs(args.ttfs, args.ttfs_max_ratio)
+        failures += t_failures
+        report += t_report
     if args.slo:
         s_failures, s_report = check_slo(args.slo)
         failures += s_failures

@@ -457,7 +457,8 @@ def _escape_sink(mod, call, free, launch_calls):
 # ---------------------------------------------------------------------------
 
 GL002_PATHS = (f"{PKG}/core/checkpoint.py", f"{PKG}/utils/resilience.py",
-               f"{PKG}/utils/scheduler.py", "launch.py")
+               f"{PKG}/utils/scheduler.py", f"{PKG}/core/xcache.py",
+               f"{PKG}/core/reshard.py", "launch.py")
 _FS_OPS = {
     "open",
     "os.replace",
@@ -770,7 +771,8 @@ GL005_PATHS = (f"{PKG}/utils/chaos.py", f"{PKG}/data/sampler.py",
                f"{PKG}/serve/engine.py", f"{PKG}/serve/loadgen.py",
                f"{PKG}/serve/prefix_cache.py", f"{PKG}/serve/router.py",
                f"{PKG}/serve/slo.py", f"{PKG}/serve/spec_decode.py",
-               f"{PKG}/utils/scheduler.py", "launch.py")
+               f"{PKG}/utils/scheduler.py", f"{PKG}/core/reshard.py",
+               f"{PKG}/core/xcache.py", "launch.py")
 _NP_UNSEEDED = {
     "rand",
     "randn",

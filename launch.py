@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import signal
 import socket
 import subprocess
@@ -300,6 +301,99 @@ def merge_traces(cmd: list[str]) -> None:
         print(f"launch.py: trace merge failed ({e})", file=sys.stderr)
 
 
+def start_reshard(ckdir: str, world: int):
+    """Kick off the background checkpoint re-shard (core/reshard.py).
+
+    The supervisor knows the surviving world the moment it reads the dead
+    host records — *before* the restart backoff ends — so the consolidation
+    of the newest committed checkpoint overlaps the backoff window instead
+    of the relaunch's restore path. Best-effort: a failure to even spawn
+    just means the relaunch restores the original layout.
+    """
+    mod = "pytorch_distributed_training_example_tpu.core.reshard"
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", mod, "--checkpoint-dir", ckdir,
+             "--world", str(world)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+    except OSError as e:
+        print(f"launch.py: background re-shard failed to start ({e})",
+              file=sys.stderr)
+        return None
+    print(f"launch.py: background re-shard started for world {world} "
+          f"(pid {proc.pid})", file=sys.stderr)
+    return proc
+
+
+def finish_reshard(proc, ckdir: str, timeout_s: float = 60.0) -> None:
+    """Join the background re-shard before relaunching.
+
+    A hung or failed re-shard must never block the restart — the relaunch
+    simply restores the original (un-consolidated) layout. Killing it is
+    safe at any instant: reshard.py commits via the same ``.old`` set-aside
+    swap as checkpoint.py, so a committed copy of the step always exists;
+    only the ``.saving.reshard`` attempt dir can be left behind, and we
+    sweep those here (the Checkpointer never prunes that suffix).
+    """
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+        code = proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        code = None
+    if code == 0:
+        print("launch.py: background re-shard ready — the relaunch restores "
+              "a consolidated checkpoint", file=sys.stderr)
+        return
+    if code is None:
+        print(f"launch.py: background re-shard overran the {timeout_s:.0f}s "
+              "restart window — killed; the relaunch restores the original "
+              "layout", file=sys.stderr)
+    else:
+        tail = (err or "").strip().splitlines()
+        detail = f": {tail[-1]}" if tail else ""
+        print(f"launch.py: background re-shard exit {code}{detail}",
+              file=sys.stderr)
+    try:
+        for name in retriable_io(os.listdir, ckdir, _what="reshard sweep"):
+            if name.startswith("step_") and name.endswith(".saving.reshard"):
+                shutil.rmtree(os.path.join(ckdir, name), ignore_errors=True)
+    except OSError:
+        pass
+
+
+def clear_stale_run_id(ckdir: str | None) -> None:
+    """Remove a torn ``run_id.json`` before relaunching.
+
+    An attempt killed mid-write (host loss, preemption during startup) can
+    leave the shared run-identity file truncated. Rank 0 of the relaunch
+    refuses to trust it and every rank would fall back to per-process ids —
+    telemetry artifacts from the same logical run would then never merge.
+    The supervisor owns the restart boundary, so it clears the wreck here,
+    loudly; a *healthy* file is preserved (attempt counters must keep
+    monotonically increasing across restarts).
+    """
+    if not ckdir:
+        return
+    path = os.path.join(ckdir, "run_id.json")
+    if not os.path.exists(path):
+        return
+    try:
+        str(retriable_io(_read_json, path, _what="run_id check")["run_id"])
+        return  # healthy: keep the shared identity
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    print(f"launch.py: {path} is torn (an earlier attempt died mid-write) — "
+          "clearing it so the relaunch re-establishes a shared run identity",
+          file=sys.stderr)
+    try:
+        retriable_io(os.unlink, path, _what="run_id clear")
+    except OSError as e:
+        print(f"launch.py: could not clear torn run_id.json ({e})",
+              file=sys.stderr)
+
+
 def supervise(args, cmd, elastic) -> int:
     """The restart loop: run the gang until a terminal exit code."""
     # The elastic "world" is whichever knob actually multiplexes hosts in
@@ -308,6 +402,7 @@ def supervise(args, cmd, elastic) -> int:
     world_attr = "nprocs" if args.nprocs > 1 else "cpu_devices"
     dead_seen: set[int] = set()
     base_world: int | None = None  # launch-time size: the grow ceiling
+    reshard_proc = None  # background checkpoint consolidation, one at a time
 
     restarts = 0
     while True:
@@ -358,12 +453,21 @@ def supervise(args, cmd, elastic) -> int:
                           f"returned, relaunching at world size {new_world} "
                           f"(was {world})", file=sys.stderr)
                 setattr(args, world_attr, new_world)
+                if ckdir and new_world and reshard_proc is None:
+                    # Overlap the backoff: consolidate the newest committed
+                    # checkpoint for the surviving world while nothing runs.
+                    reshard_proc = start_reshard(ckdir, new_world)
         restarts += 1
         delay = args.restart_backoff * 2 ** (restarts - 1)
         print(f"launch.py: exit code {code} -> restart {restarts}/"
               f"{args.max_restarts} with --resume auto in {delay:.1f}s",
               file=sys.stderr)
         time.sleep(delay)
+        if reshard_proc is not None:
+            finish_reshard(reshard_proc,
+                           find_flag(cmd, "--checkpoint-dir") or "")
+            reshard_proc = None
+        clear_stale_run_id(find_flag(cmd, "--checkpoint-dir"))
         if _interrupted:  # Ctrl-C during the backoff window
             return code
         if "--resume" not in cmd:

@@ -296,6 +296,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="request-span ring-buffer capacity per replica; "
                         "overflow rotates generations and counts "
                         "dropped_spans (default 4096)")
+    p.add_argument("--xcache", action="store_true", default=None,
+                   help="persistent executable cache (core/xcache.py): "
+                        "serialize the compiled train step under "
+                        "<checkpoint-dir>/xcache keyed by a topology/knob "
+                        "fingerprint so elastic relaunches at a seen "
+                        "topology skip XLA compilation")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                    help="force a jax platform (dev: run the TPU code path on CPU)")
     p.add_argument("--fake-devices", type=int, default=None,
@@ -346,12 +352,19 @@ def main(argv=None):
     jax.config.update("jax_threefry_partitionable", True)
 
     # Persistent compile cache: repeat invocations (dev loops, restarts,
-    # --resume) skip XLA recompilation. Opt out / relocate via env.
+    # --resume) skip XLA recompilation. Opt out / relocate via env. Under
+    # --xcache the cache co-locates with the serialized executables in
+    # <checkpoint-dir>/xcache so it survives with the run, and it doubles
+    # as the warm-restart fallback where executable serialization is
+    # unsupported (core/xcache.py docstring).
     if os.environ.get("JAX_COMPILATION_CACHE_DIR", "unset") == "unset":
         import jax
 
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/pdtx_compile_cache")
+        if args.xcache and args.checkpoint_dir:
+            cache_dir = os.path.join(args.checkpoint_dir, "xcache", "jaxcache")
+        else:
+            cache_dir = "/tmp/pdtx_compile_cache"
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     # Bootstrap BEFORE touching jax.devices(): in multi-host mode every

@@ -1,0 +1,275 @@
+"""Background checkpoint re-shard — restore-ready layout before the restart.
+
+The second half of the restart tax (``core/xcache.py`` docstring owns the
+first): an elastic relaunch restores a checkpoint written under the OLD
+topology, so ``_assemble_sharded`` re-slices N region files per leaf through
+mmap intersection while the new attempt's devices sit idle. But the elastic
+supervisor (``launch.py``) knows the surviving world the moment it reads
+``dead_hosts.jsonl`` — *before* the restart backoff ends — so this module
+runs in a background subprocess during that window and rewrites the newest
+committed checkpoint into a **consolidated layout**: one contiguous
+full-leaf file per array, exactly what a fresh restore at any topology
+assembles fastest (every target shard is one contiguous read from one file
+instead of an intersection over the old world's regions).
+
+Integrity discipline mirrors ``core/checkpoint.py`` end to end:
+
+- every source region file is CRC-verified against the manifest BEFORE any
+  output is written; a mismatch quarantines the source step
+  (``step_X.corrupt``, resume-ineligible) and exits loudly — a torn source
+  must never launder into a fresh-looking consolidated copy;
+- output is written into a ``step_X.saving.reshard`` attempt dir with
+  per-file CRCs in a rewritten manifest, ``COMMIT`` written inside, then
+  swapped over the source via the ``.old`` set-aside rename pair (healed by
+  ``Checkpointer._recover_interrupted_replace`` if interrupted), so at every
+  instant a committed copy of the step exists;
+- the manifest's ``extra`` dict is preserved VERBATIM — it records the
+  *saving* geometry (global batch, mesh shape) that elastic batch-rescale
+  planning starts from; only ``geometry`` (which layout is on disk) is
+  updated, plus a ``resharded`` marker recording the target world and the
+  source geometry for provenance.
+
+Deliberately jax-free (numpy + ``retriable_io``): the supervisor spawns it
+as ``python -m ...core.reshard --checkpoint-dir D --world N`` during the
+restart backoff, and it must come up in milliseconds, not pay a jax import.
+
+Exit codes: 0 = re-sharded (or already consolidated), 3 = nothing
+committed to re-shard, 4 = source corrupt (quarantined), 1 = other error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import re
+import shutil
+import sys
+import zlib
+
+import numpy as np
+
+from pytorch_distributed_training_example_tpu.utils.resilience import (
+    retriable_io)
+
+log = logging.getLogger("pdtx")
+
+# Mirrors core/checkpoint.py (not imported: that module imports jax).
+COMMIT_FILE = "COMMIT"
+MANIFEST_FILE = "manifest.json"
+OLD_SUFFIX = ".old"
+RESHARD_SUFFIX = ".saving.reshard"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _read_json(path: str):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def committed_steps(directory: str) -> list[int]:
+    """Committed steps with a parseable manifest, ascending."""
+    out = []
+    try:
+        names = retriable_io(os.listdir, directory, _what="reshard read")
+    except OSError:
+        return []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        step_dir = os.path.join(directory, name)
+        if not os.path.exists(os.path.join(step_dir, COMMIT_FILE)):
+            continue
+        try:
+            _read_json(os.path.join(step_dir, MANIFEST_FILE))
+        except (OSError, ValueError):
+            continue
+        out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _union_file_lists(step_dir: str, manifest: dict) -> dict:
+    """Merge per-host ``files.p*.json`` sentinels into the manifest's lists
+    (same union restore performs)."""
+    leaves = manifest["leaves"]
+    for fn in retriable_io(os.listdir, step_dir, _what="reshard read"):
+        if fn.startswith("files.p") and fn.endswith(".json"):
+            extra_files = retriable_io(
+                _read_json, os.path.join(step_dir, fn), _what="reshard read")
+            for p, files in extra_files.items():
+                known = {e["file"] for e in leaves[p]["files"]}
+                leaves[p]["files"] += [e for e in files
+                                       if e["file"] not in known]
+    return leaves
+
+
+def _verify_sources(step_dir: str, leaves: dict) -> str | None:
+    """CRC every source region file; returns an error string on mismatch."""
+    arrays_dir = os.path.join(step_dir, "arrays")
+    checked: set[str] = set()
+    for path, meta in leaves.items():
+        for entry in meta["files"]:
+            fname = entry["file"]
+            if "crc32" not in entry or fname in checked:
+                continue
+            checked.add(fname)
+            fpath = os.path.join(arrays_dir, fname)
+            try:
+                got = retriable_io(_file_crc32, fpath, _what="reshard crc")
+            except OSError as e:
+                return f"{fname}: unreadable ({e})"
+            if got != int(entry["crc32"]):
+                return (f"{fname}: CRC mismatch (manifest "
+                        f"{int(entry['crc32']):#010x}, file {got:#010x})")
+    return None
+
+
+def _assemble_full(arrays_dir: str, meta: dict) -> np.ndarray:
+    """Materialize one whole leaf from its region files (mmap reads, so
+    only each region's bytes are touched; peak memory is one full leaf —
+    this runs host-side in the supervisor's background process, never on a
+    training host's budget)."""
+    full = np.empty(meta["shape"], dtype=np.dtype(meta["dtype"]))
+    for entry in meta["files"]:
+        region = retriable_io(
+            np.load, os.path.join(arrays_dir, entry["file"]), mmap_mode="r",
+            _what="reshard read")
+        if full.ndim == 0:
+            full = np.array(region).reshape(())
+        else:
+            full[tuple(slice(a, b) for a, b in entry["index"])] = region
+    return full
+
+
+def reshard_step(directory: str, step: int, world: int) -> bool:
+    """Consolidate ``step_<step>`` to one file per leaf; True on success.
+
+    Raises ``ValueError`` after quarantining the source when a region file
+    fails CRC verification.
+    """
+    step_dir = os.path.join(directory, f"step_{step:08d}")
+    manifest = retriable_io(
+        _read_json, os.path.join(step_dir, MANIFEST_FILE),
+        _what="reshard read")
+    leaves = _union_file_lists(step_dir, manifest)
+    if manifest.get("resharded") and all(
+            len(m["files"]) <= 1 for m in leaves.values()):
+        log.info("reshard: step %d already consolidated — nothing to do",
+                 step)
+        return True
+
+    err = _verify_sources(step_dir, leaves)
+    if err is not None:
+        quarantined = f"{step_dir}.corrupt"
+        retriable_io(os.rename, step_dir, quarantined,
+                     _what="reshard quarantine")
+        log.error("reshard: source checkpoint step %d FAILED verification "
+                  "(%s) — quarantined -> %s; the next restore falls back to "
+                  "an older committed step", step, err, quarantined)
+        raise ValueError(f"source step {step} corrupt: {err}")
+
+    tmp = step_dir + RESHARD_SUFFIX
+    shutil.rmtree(tmp, ignore_errors=True)
+    arrays_src = os.path.join(step_dir, "arrays")
+    arrays_out = os.path.join(tmp, "arrays")
+    retriable_io(os.makedirs, arrays_out, exist_ok=True,
+                 _what="reshard write")
+    new_leaves: dict = {}
+    for path, meta in leaves.items():
+        full = _assemble_full(arrays_src, meta)
+        safe = path.replace("/", ".")
+        fname = f"{safe}.p0.0.npy"
+        fpath = os.path.join(arrays_out, fname)
+        retriable_io(np.save, fpath, full, _what="reshard write")
+        index = ([[0, s] for s in full.shape] if full.ndim else [[0, 0]])
+        new_leaves[path] = {
+            "shape": list(meta["shape"]), "dtype": str(meta["dtype"]),
+            "files": [{"file": fname, "index": index,
+                       "crc32": retriable_io(_file_crc32, fpath,
+                                             _what="reshard crc")}]}
+        del full
+
+    src_geometry = manifest.get("geometry") or {}
+    new_manifest = {
+        "step": manifest.get("step", step),
+        # Preserved verbatim: the SAVING geometry elastic rescale plans from.
+        "extra": manifest.get("extra", {}),
+        # What is on disk now: one host wrote one full-leaf region per array.
+        "geometry": {"process_count": 1, "device_count": int(world)},
+        "resharded": {"world": int(world),
+                      "source_geometry": src_geometry},
+        "leaves": new_leaves,
+    }
+
+    def _write_json(path, obj):
+        with open(path, "w") as fh:
+            json.dump(obj, fh)
+
+    retriable_io(_write_json, os.path.join(tmp, MANIFEST_FILE), new_manifest,
+                 _what="reshard write")
+
+    def _write_commit():
+        with open(os.path.join(tmp, COMMIT_FILE), "w") as fh:
+            fh.write(str(step))
+
+    retriable_io(_write_commit, _what="reshard commit")
+    # The .old set-aside swap (core/checkpoint.py save discipline): a crash
+    # between the renames is healed at next startup, and a committed copy of
+    # the step exists at every instant.
+    old_dir = step_dir + OLD_SUFFIX
+    shutil.rmtree(old_dir, ignore_errors=True)
+    retriable_io(os.rename, step_dir, old_dir, _what="reshard swap")
+    retriable_io(os.rename, tmp, step_dir, _what="reshard swap")
+    shutil.rmtree(old_dir, ignore_errors=True)
+    n_files = sum(len(m["files"]) for m in leaves.values())
+    log.warning("reshard: step %d consolidated for world %d — %d region "
+                "files -> %d full-leaf files", step, world, n_files,
+                len(new_leaves))
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Background checkpoint re-shard (launch.py elastic)")
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--world", type=int, required=True,
+                   help="surviving world size the relaunch targets")
+    p.add_argument("--step", type=int, default=None,
+                   help="explicit step (default: newest committed)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname).1s reshard: %(message)s",
+                        stream=sys.stderr)
+    steps = committed_steps(args.checkpoint_dir)
+    step = args.step if args.step is not None else (steps[-1] if steps else None)
+    if step is None or (args.step is not None and args.step not in steps):
+        log.warning("reshard: no committed checkpoint in %s — nothing to "
+                    "re-shard", args.checkpoint_dir)
+        return 3
+    try:
+        reshard_step(args.checkpoint_dir, step, args.world)
+    except ValueError:
+        return 4
+    except OSError as e:
+        log.error("reshard: failed (%s) — the relaunch restores the "
+                  "original layout instead", e)
+        shutil.rmtree(
+            os.path.join(args.checkpoint_dir,
+                         f"step_{step:08d}{RESHARD_SUFFIX}"),
+            ignore_errors=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,7 @@ from pytorch_distributed_training_example_tpu.core import (
     optim,
     precision as precision_lib,
     train_loop,
+    xcache as xcache_lib,
 )
 from pytorch_distributed_training_example_tpu.data import (
     datasets as datasets_lib,
@@ -63,6 +64,9 @@ class Trainer:
         self.telemetry = None
         self._watchdog: watchdog_lib.Watchdog | None = None
         self._compiled = False
+        # "warm" when the first step ran an xcache-deserialized executable,
+        # else "cold" — lands in goodput.json as ttfs_mode (core/xcache.py).
+        self._xcache_mode = "cold"
         if cfg.telemetry:
             tdir = cfg.checkpoint_dir or os.path.join(
                 tempfile.gettempdir(), "pdtx_telemetry")
@@ -737,9 +741,13 @@ class Trainer:
                     # "compile" span covers it (dispatch is async — without
                     # the block the cost would leak into later step spans).
                     with self._span("compile"):
-                        self.state, metrics = self.train_step(self.state, batch)
+                        metrics = self._first_dispatch(batch)
                         jax.tree.map(lambda x: x.block_until_ready(), metrics)
                     self._compiled = True
+                    if tele is not None:
+                        # Time-to-first-step: wall from process start to the
+                        # first completed optimizer step, cold vs warm.
+                        tele.mark_first_step(self._xcache_mode)
                 else:
                     with self._span("step"):
                         self.state, metrics = self.train_step(self.state, batch)
@@ -823,6 +831,50 @@ class Trainer:
                     self._graceful_shutdown(epoch, i + 1)
                 i += 1
 
+    def _first_dispatch(self, batch):
+        """Run the first step, consulting the persistent executable cache.
+
+        With ``--xcache`` + a checkpoint dir, the ``lower().compile()``
+        front-end is keyed on a topology/knob/aval fingerprint
+        (core/xcache.py): a hit deserializes the compiled executable and
+        skips XLA entirely; a miss compiles AOT and serializes the result
+        for the next attempt. Either way the compiled executable replaces
+        ``self.train_step`` for the rest of the run — an AOT call never
+        populates jit's dispatch cache, so leaving the jit wrapper in
+        place would re-trace on step 2.
+        """
+        cfg = self.cfg
+        root = (self.checkpointer.directory
+                if cfg.xcache and self.checkpointer is not None else None)
+        if root is None:
+            self.state, metrics = self.train_step(self.state, batch)
+            return metrics
+        fields = xcache_lib.fingerprint(mesh=self.mesh, config=cfg,
+                                        example_args=(self.state, batch))
+        compiled = xcache_lib.load(root, fields, example=(self.state, batch))
+        if compiled is not None:
+            try:
+                self.state, metrics = compiled(self.state, batch)
+                self.train_step = compiled
+                self._xcache_mode = "warm"
+                return metrics
+            except Exception as e:  # noqa: BLE001 — never a stale executable
+                # The fingerprint should make this unreachable; if the
+                # deserialized executable still rejects our inputs, refuse
+                # it loudly and compile cold rather than trust it.
+                log.error("xcache: cached executable rejected our inputs "
+                          "(%s: %s) — falling back to cold compile",
+                          type(e).__name__, e)
+        lowered = self.train_step.lower(self.state, batch)
+        compiled = lowered.compile()
+        self.state, metrics = compiled(self.state, batch)
+        # Save AFTER the first execution: the metrics pytree is part of the
+        # entry (reconstruct tree mode) and only exists once the step ran.
+        xcache_lib.save(root, fields, compiled,
+                        example=(self.state, batch), metrics=metrics)
+        self.train_step = compiled
+        return metrics
+
     def _publish(self, gstep: int, epoch: int, m: dict, dt: float):
         """Refresh the live metrics surface (rank 0, log cadence): the
         Prometheus gauges and the atomically-replaced progress.json."""
@@ -839,6 +891,9 @@ class Trainer:
                 attempt=g["attempts"],
                 straggler_warnings=self.telemetry.guard.warnings,
                 anomaly_count=self.telemetry.guard.trips)
+            if g.get("time_to_first_step_s") is not None:
+                # Renders as the pdtx_ttfs_seconds gauge on /metrics.
+                row["ttfs_seconds"] = g["time_to_first_step_s"]
         self._progress = row
         if self._metrics_server is not None:
             self._metrics_server.update(**row)

@@ -25,6 +25,16 @@ rather than trusted on inspection. ``--chaos`` takes a comma-separated spec:
                            ``os._exit(HOST_LOST_EXIT_CODE)`` — no emergency
                            checkpoint, exactly like real hardware. An elastic
                            supervisor relaunches one host smaller.
+    slow_host@step=4:rank=1  CHRONIC straggler: from global batch 4 onward,
+                           rank 1 sleeps ``SLOW_S`` before yielding EVERY
+                           batch (a failing NIC / thermal throttle, not a
+                           one-off hiccup like loader_stall). Unlike every
+                           other event it keeps firing for the life of the
+                           process — that is the point: the straggler
+                           detector must flag the same host in consecutive
+                           windows so the fleet scheduler's ``evict_after``
+                           verdict trips. Logged to chaos.jsonl once, on
+                           first fire.
 
 Counters are GLOBAL (step/batch indices are ``epoch * steps_per_epoch + i``;
 save counts every ``Checkpointer.save`` call this process makes), and every
@@ -67,6 +77,7 @@ _SITES = {
     "ckpt_io_error": "save",
     "truncate_ckpt": "save",
     "kill_host": "step",
+    "slow_host": "step",
 }
 
 
@@ -134,6 +145,9 @@ class ChaosEngine:
 
     IO_FAILURES = 2   # < retriable_io's default 4 attempts: retry succeeds
     STALL_S = 1.0
+    SLOW_S = 0.25     # per-batch chronic drag: well past the straggler
+                      # detector's absolute floor, small enough to keep
+                      # same-seed drill runtimes sane
 
     def __init__(self, spec: str, seed: int = 0, log_dir: str | None = None,
                  rank: int | None = None):
@@ -253,6 +267,17 @@ class ChaosEngine:
         if ev is not None:
             self._record(ev, stall_s=self.STALL_S)
             time.sleep(self.STALL_S)
+        # slow_host is CHRONIC: from its trip batch onward it drags every
+        # yield on the targeted rank — ``fired`` only gates the one-time
+        # chaos.jsonl row (keeping same-seed logs byte-diffable), never the
+        # effect itself.
+        for ev in self.events:
+            if (ev.name == "slow_host" and g >= ev.value
+                    and (ev.rank is None or ev.rank == self._proc_rank())):
+                if not ev.fired:
+                    ev.fired = True
+                    self._record(ev, slow_s=self.SLOW_S, chronic=True)
+                time.sleep(self.SLOW_S)
         ev = self._take("nan_grad", g)
         if ev is not None:
             float_keys = [k for k, v in batch.items()

@@ -124,6 +124,13 @@ class Config:
     # e.g. "sigterm@step=7,ckpt_io_error@save=2" — None disables
     chaos: str | None = None
     chaos_seed: int | None = None  # defaults to `seed` when unset
+    # r21 instant restart (core/xcache.py): persist the train step's
+    # compiled executable under <checkpoint_dir>/xcache keyed by a
+    # topology/knob/aval fingerprint, so a supervisor relaunch at a
+    # previously seen topology deserializes instead of compiling. The jax
+    # persistent compilation cache is pointed at the same directory as the
+    # fallback where executable serialization is unsupported.
+    xcache: bool = False
     # profiling
     profile_steps: str | None = None  # "start:stop" step range
     profile_dir: str = "/tmp/pdtx_profile"

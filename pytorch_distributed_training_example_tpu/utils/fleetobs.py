@@ -110,19 +110,40 @@ def ensure_run_id(directory: str, fallback: str, *, fresh: bool = False,
                 os.unlink(path)
             except OSError:
                 pass
-        try:
-            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
-            with os.fdopen(fd, "w") as fh:
-                fh.write(json.dumps({
-                    "schema_version": SCHEMA_VERSION, "run_id": fallback,
-                    "host": host_identity(), "time": time.time()}))
-            return fallback
-        except FileExistsError:
-            pass  # resume: a previous attempt's id survives — read it
-        except OSError as e:
-            log.warning("fleetobs: cannot create %s (%s) — per-process "
-                        "run id %s", path, e, fallback)
-            return fallback
+        for reclaim in (False, True):
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+                with os.fdopen(fd, "w") as fh:
+                    fh.write(json.dumps({
+                        "schema_version": SCHEMA_VERSION, "run_id": fallback,
+                        "host": host_identity(), "time": time.time()}))
+                return fallback
+            except FileExistsError:
+                # Resume: a previous attempt's id survives — read it below.
+                # BUT an attempt killed mid-write leaves a TORN file, and
+                # without this check rank 0 would poll-read its own torn
+                # file to the deadline on EVERY relaunch (the supervisor
+                # never clears it). Validate and reclaim loudly instead.
+                try:
+                    with open(path) as fh:
+                        str(json.load(fh)["run_id"])
+                    break  # healthy survivor — the read loop returns it
+                except (OSError, ValueError, KeyError) as e:
+                    if reclaim:
+                        break  # second torn file in a row — give up loudly
+                    log.error(
+                        "fleetobs: %s is torn (%s: %s) — an earlier attempt "
+                        "died mid-write; reclaiming run identity", path,
+                        type(e).__name__, e)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        break
+                    continue  # retry the exclusive create once
+            except OSError as e:
+                log.warning("fleetobs: cannot create %s (%s) — per-process "
+                            "run id %s", path, e, fallback)
+                return fallback
     deadline = time.monotonic() + (timeout_s if rank else 1.0)
     while True:
         try:
@@ -132,8 +153,15 @@ def ensure_run_id(directory: str, fallback: str, *, fresh: bool = False,
             if time.monotonic() >= deadline:
                 break
             time.sleep(0.05)
-    log.warning("fleetobs: no readable %s — falling back to per-process "
-                "run id %s", path, fallback)
+    if os.path.exists(path):
+        log.error("fleetobs: %s exists but stayed unreadable past the "
+                  "%.1fs deadline (torn write from a killed attempt?) — "
+                  "falling back to per-process run id %s; artifacts from "
+                  "this rank will not merge under the shared identity",
+                  path, timeout_s if rank else 1.0, fallback)
+    else:
+        log.warning("fleetobs: no readable %s — falling back to per-process "
+                    "run id %s", path, fallback)
     return fallback
 
 
@@ -345,6 +373,52 @@ def straggler_gauges(rows: list[dict], prefix: str = "fleet_straggler"
     if out[f"{prefix}_flagged_total"]:
         out[f"{prefix}_worst_delta_s"] = round(worst, 4)
     return out
+
+
+def append_straggler_flag(directory: str, row: dict) -> None:
+    """Append one LIVE flagged row to ``straggler.jsonl`` (single ``write``,
+    so a killed host tears at most the final line).
+
+    The in-run straggler monitor feeds the scheduler's eviction reader
+    *while the job runs* — the offline ``detect_stragglers`` merge only
+    lands after an attempt exits, far too late to evict a chronically slow
+    host. The post-run ``write_stragglers`` rewrite replaces these rows
+    with the fleet-level attribution of the same events.
+    """
+    try:
+        with open(os.path.join(directory, STRAGGLER_FILE), "a") as fh:
+            fh.write(json.dumps(row, default=float) + "\n")
+    except OSError as e:
+        log.warning("fleetobs: straggler append failed (%s)", e)
+
+
+def read_chronic_straggler(path: str, consecutive: int) -> dict | None:
+    """Trailing run of flagged rows blaming one rank — the eviction signal.
+
+    Jax-free (the ``read_slo_attainment`` pattern) so the fleet scheduler
+    and launcher consume ``straggler.jsonl`` without importing jax. Scans
+    rows in file order and measures the TRAILING streak of ``flagged``
+    rows that name one consistent ``slowest_rank``; an unflagged row or a
+    different culprit resets it. Returns ``{"rank", "streak", "rows"}``
+    when the streak reaches ``consecutive`` (``rows`` = total straggler
+    rows seen, the scheduler's evidence-freshness cursor), else None.
+    Missing/torn files are no evidence, never an error.
+    """
+    streak, rank, nrows = 0, None, 0
+    for row in read_jsonl_tolerant(path):
+        if "flagged" not in row and "slowest_rank" not in row:
+            continue  # meta/header rows
+        nrows += 1
+        r = row.get("slowest_rank")
+        if not row.get("flagged") or r is None:
+            streak, rank = 0, None
+            continue
+        r = int(r)
+        streak = streak + 1 if r == rank else 1
+        rank = r
+    if rank is not None and streak >= max(int(consecutive), 1):
+        return {"rank": rank, "streak": streak, "rows": nrows}
+    return None
 
 
 class StragglerMonitor:

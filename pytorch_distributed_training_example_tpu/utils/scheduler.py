@@ -38,6 +38,20 @@ Model
   restart budget with doubling backoff. A job waiting out its backoff keeps
   a *claim* on its minimum so lower-priority jobs cannot squat on devices it
   is about to take back — claims bind only tiers below the claimant.
+- **Straggler eviction** (``evict_after``): train jobs opt in to having the
+  scheduler act on the straggler detector's verdicts. When a job's
+  ``straggler.jsonl`` (written by the jax-side detector, read through the
+  jax-free :mod:`utils.fleetobs` helpers) shows the SAME host flagged in
+  ``evict_after`` consecutive windows, the scheduler records that host dead
+  (the elastic dead-host protocol — the job's ceiling shrinks by one) and
+  preempts the job through the normal SIGTERM path, so the exit is the
+  graceful code and burns *no* restart budget; the relaunch backfills one
+  host smaller. Suspicion **decays**: after ``evict_decay`` further
+  scheduling decisions the host-return record is appended and the ceiling
+  grows back (a transient slow host — thermal throttle, a noisy neighbour —
+  is not branded forever). A job is never evicted below ``min_world``, and
+  stale evidence never re-evicts: only flag rows appended since the last
+  eviction count.
 
 Determinism contract (the robustness gate diffs placement logs byte-for-
 byte across same-seed chaos drills): no RNG, no wall-clock anywhere in a
@@ -121,6 +135,13 @@ class JobSpec:
     # checkpoint-and-yield (serve/run.py), so the fleet surfaces them
     # separately and the launcher exports PDTX_JOB_KIND to the child.
     kind: str = "train"
+    # Straggler-fed eviction (train jobs): preempt + mark one host dead when
+    # straggler.jsonl flags it this many CONSECUTIVE windows. 0 = disabled.
+    evict_after: int = 0
+    # Scheduling decisions after which an evicted host's suspicion decays
+    # (host-return record appended; the ceiling grows back). Decision-count
+    # based, not wall-clock — placement logs stay byte-reproducible.
+    evict_decay: int = 8
 
     @property
     def checkpoint_dir(self) -> str | None:
@@ -149,6 +170,12 @@ class JobState:
     #: D'Hondt quotient — a replica missing its latency targets bids for
     #: surplus devices at a discount, it is never starved below MIN.
     slo_attainment: float = 1.0
+    #: Straggler-eviction bookkeeping: how many straggler.jsonl rows were
+    #: consumed by the last eviction (stale evidence never re-evicts), and
+    #: the evicted hosts still under suspicion as (host, seq-at-eviction)
+    #: pairs — suspicion decays after ``evict_decay`` further decisions.
+    straggler_rows_seen: int = 0
+    suspects: list[tuple[int, int]] = dataclasses.field(default_factory=list)
 
     @property
     def name(self) -> str:
@@ -193,6 +220,17 @@ def load_jobs(path: str) -> tuple[int, list[JobSpec]]:
         if kind not in ("train", "serve"):
             raise ValueError(f"job {name!r}: kind must be 'train' or "
                              f"'serve', got {kind!r}")
+        evict_after = int(row.get("evict_after", 0))
+        if evict_after < 0:
+            raise ValueError(f"job {name!r}: evict_after must be >= 0 "
+                             f"(0 disables), got {evict_after}")
+        if evict_after and kind != "train":
+            raise ValueError(f"job {name!r}: evict_after applies to train "
+                             f"jobs only (kind={kind!r})")
+        evict_decay = int(row.get("evict_decay", 8))
+        if evict_decay < 1:
+            raise ValueError(f"job {name!r}: evict_decay must be >= 1, "
+                             f"got {evict_decay}")
         specs.append(JobSpec(
             name=name, cmd=cmd, priority=int(row.get("priority", 0)),
             min_world=min_world, max_world=max_world,
@@ -201,7 +239,7 @@ def load_jobs(path: str) -> tuple[int, list[JobSpec]]:
             after=row.get("after"), after_event=after_event,
             env=tuple(sorted((str(k), str(v))
                              for k, v in (row.get("env") or {}).items())),
-            kind=kind))
+            kind=kind, evict_after=evict_after, evict_decay=evict_decay))
     if not specs:
         raise ValueError("jobs.json has no jobs")
     for s in specs:
@@ -369,6 +407,14 @@ class FleetScheduler:
         for st in self.jobs.values():
             if st.spec.kind == "serve":
                 self._refresh_slo(st)
+        # Straggler feedback (name order — deterministic): decay first so a
+        # rehabilitated host's ceiling is back before this pass places
+        # anything, then evict chronic stragglers; their devices count as
+        # arriving supply (PREEMPTING) for the placement below.
+        for name in sorted(self.jobs):
+            self._decay_suspects(self.jobs[name], decisions)
+        for name in sorted(self.jobs):
+            self._evict_straggler(self.jobs[name], decisions)
         eligible = self._eligible(now_s)
         incoming = sum(st.world for st in self.jobs.values()
                        if st.status == PREEMPTING)
@@ -447,6 +493,67 @@ class FleetScheduler:
                     f"{st.spec.min_world}:{min(st.spec.max_world, self.pool)}"
                     f", cap {self._cap(st)}"))
         return decisions
+
+    def _evict_straggler(self, st: JobState, decisions: list[dict]) -> None:
+        """Preempt ``st`` and record its chronic straggler dead, if the
+        evidence says so.
+
+        Reads the job's ``straggler.jsonl`` through the jax-free fleetobs
+        reader; acts only on flag rows appended SINCE the last eviction
+        (the cursor), never evicts below ``min_world``, and quotes only
+        configuration in the log row (the threshold, not the observed
+        streak) so same-seed placement logs stay byte-identical.
+        """
+        sp = st.spec
+        if sp.kind != "train" or sp.evict_after < 1 or st.status != RUNNING:
+            return
+        ckdir = sp.checkpoint_dir
+        if not ckdir or not os.path.isdir(ckdir):
+            return
+        chronic = fleetobs.read_chronic_straggler(
+            os.path.join(ckdir, fleetobs.STRAGGLER_FILE), sp.evict_after)
+        if chronic is None or chronic["rows"] <= st.straggler_rows_seen:
+            return  # no verdict, or no new evidence since the last eviction
+        host = int(chronic["rank"])
+        # Prospective ceiling check: evicting must leave the job placeable
+        # (set-union, not +1 — re-evicting an already-dead rank id does not
+        # shrink the ceiling further).
+        dead_after = len(elastic.effective_dead_hosts(ckdir) | {host})
+        if min(sp.max_world, self.pool) - dead_after < sp.min_world:
+            return  # never shrink a job below its minimum
+        st.straggler_rows_seen = int(chronic["rows"])
+        elastic.record_dead_host(ckdir, host, world=st.world,
+                                 reason="scheduler straggler eviction")
+        st.status = PREEMPTING
+        row = self._log(
+            "preempt", st, st.world,
+            f"straggler: host {host} flagged {sp.evict_after} consecutive "
+            f"windows -> evict (suspicion decays after {sp.evict_decay} "
+            f"decisions)")
+        st.suspects.append((host, row["seq"]))
+        decisions.append(row)
+
+    def _decay_suspects(self, st: JobState, decisions: list[dict]) -> None:
+        """Readmit evicted hosts whose suspicion has aged out: append the
+        host-return record (the ceiling grows back; the next relaunch may
+        use the host again) after ``evict_decay`` scheduling decisions —
+        decision-sequence based, never wall-clock."""
+        if not st.suspects:
+            return
+        keep: list[tuple[int, int]] = []
+        for host, seq_at in st.suspects:
+            if self._seq - seq_at < st.spec.evict_decay:
+                keep.append((host, seq_at))
+                continue
+            ckdir = st.spec.checkpoint_dir
+            if ckdir:
+                elastic.record_host_return(
+                    ckdir, host, reason="straggler suspicion decayed")
+            decisions.append(self._log(
+                "readmit", st, st.world,
+                f"host {host}: straggler suspicion decayed after "
+                f"{st.spec.evict_decay} decisions — ceiling restored"))
+        st.suspects = keep
 
     def on_exit(self, name: str, code: int, now_s: float) -> dict:
         """Record a child exit and transition the job. Returns the logged

@@ -172,7 +172,16 @@ class SpanRecorder:
         self._base_counts: dict[str, int] = {}
         self._base_wall = 0.0
         self.attempts = 1
+        # Time-to-first-step (r21 instant restart): wall from construction
+        # to the first completed optimizer step, tagged cold/warm by the
+        # executable-cache outcome. History carries across attempts so the
+        # warm-vs-cold comparison lives in ONE goodput.json.
+        self._ttfs: float | None = None
+        self._ttfs_mode: str | None = None
+        self._ttfs_history: list[dict] = []
         if carry:
+            self._ttfs_history = [dict(h) for h in
+                                  (carry.get("ttfs_history") or [])]
             self._base_totals = {k: float(v) for k, v in
                                  (carry.get("categories_s") or {}).items()}
             self._base_counts = {k: int(v) for k, v in
@@ -236,6 +245,16 @@ class SpanRecorder:
     def wall_s(self) -> float:
         return time.perf_counter() - self._start
 
+    def mark_first_step(self, mode: str) -> None:
+        """Record time-to-first-step once, tagged ``cold``/``warm``."""
+        if self._ttfs is not None:
+            return
+        self._ttfs = self.wall_s
+        self._ttfs_mode = str(mode)
+        self._ttfs_history.append({"attempt": self.attempts,
+                                   "ttfs_s": round(self._ttfs, 4),
+                                   "mode": self._ttfs_mode})
+
     def trace_events(self) -> dict:
         # ``fleetobs.trace_doc`` puts otherData FIRST (torn-write salvage
         # contract) and is shared with the serving-side RequestTrace so both
@@ -279,6 +298,21 @@ class SpanRecorder:
             "attempts": self.attempts,
             "ended_at": round(time.time(), 3),
         }
+        if self._ttfs is not None:
+            out["time_to_first_step_s"] = round(self._ttfs, 4)
+            out["ttfs_mode"] = self._ttfs_mode
+        if self._ttfs_history:
+            out["ttfs_history"] = [dict(h) for h in self._ttfs_history]
+        if "restart" in totals:
+            # The restart tax decomposed: the supervisor gap between
+            # attempts plus THIS job's cumulative compile/restore spans —
+            # the three costs the executable cache + background re-shard
+            # exist to shrink.
+            out["restart_breakdown"] = {
+                "gap_s": round(totals.get("restart", 0.0), 4),
+                "compile_s": round(totals.get("compile", 0.0), 4),
+                "restore_s": round(totals.get("checkpoint_restore", 0.0), 4),
+            }
         if self.meta:
             out["meta"] = dict(self.meta)
         if self.meta.get("attempt_id"):
@@ -537,6 +571,10 @@ class Telemetry:
     def span(self, name: str):
         return self.recorder.span(name)
 
+    def mark_first_step(self, mode: str) -> None:
+        """Time-to-first-step landed (cold/warm) — forwarded to goodput."""
+        self.recorder.mark_first_step(mode)
+
     def observe(self, step: int, row: dict) -> bool:
         """Feed one fetched metrics row; returns True if the guard tripped."""
         self.last_step = int(step)
@@ -568,6 +606,16 @@ class Telemetry:
                                       input_wait_s=input_wait_s)
         if reason:
             self.guard.warn(step, reason)
+            if self.directory:
+                # Live feed for the fleet scheduler's eviction reader
+                # (fleetobs.read_chronic_straggler): the offline
+                # detect_stragglers merge only lands after the attempt
+                # exits. Same row shape as the merged attribution rows.
+                fleetobs.append_straggler_flag(self.directory, {
+                    "step": int(step), "slowest_rank": self.rank,
+                    "delta_s": round(input_wait_s, 6),
+                    "cause": "input_wait_s", "flagged": True,
+                    "source": "live", "attempt": self.recorder.attempts})
         return reason
 
     def flight_dump(self, reason: str, **extra) -> str | None:

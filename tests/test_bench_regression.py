@@ -186,3 +186,67 @@ def test_moe_weights_split_mixed_fusion():
     # contrast: the line-majority map attributes the whole fusion
     tags = ps.build_op_moe_tags(SYNTH_HLO)
     assert tags["fusion.1"] == "moe_router"
+
+
+def _goodput(tmp_path, history):
+    path = tmp_path / "goodput.json"
+    path.write_text(json.dumps({"ttfs_history": history}))
+    return str(path)
+
+
+def _ttfs(mode, s, attempt=0):
+    return {"attempt": attempt, "mode": mode, "ttfs_s": s}
+
+
+def test_ttfs_warm_beats_cold_passes(tmp_path):
+    failures, report = cr.check_ttfs(_goodput(tmp_path, [
+        _ttfs("cold", 8.0), _ttfs("warm", 1.5, 1), _ttfs("cold", 9.0, 2)]))
+    assert not failures
+    assert any("OK" in line and "x0.19" in line for line in report)
+
+
+def test_ttfs_slow_warm_fails(tmp_path):
+    # Every warm attempt must beat the SLOWEST cold by the floor; warm at
+    # 0.9x cold means the executable cache is not paying for itself.
+    failures, report = cr.check_ttfs(
+        _goodput(tmp_path, [_ttfs("cold", 8.0), _ttfs("warm", 7.2, 1)]))
+    assert failures and "not paying for itself" in failures[0]
+    assert any(line.startswith("REGRESSION") for line in report)
+    # A looser floor admits the same history.
+    failures, _ = cr.check_ttfs(
+        _goodput(tmp_path, [_ttfs("cold", 8.0), _ttfs("warm", 7.2, 1)]),
+        max_ratio=0.95)
+    assert not failures
+
+
+def test_ttfs_neutral_without_a_pair(tmp_path):
+    # All-cold (cache missing/corrupt -> quarantined) is the cache layer
+    # behaving correctly, not a regression.
+    for history in ([_ttfs("cold", 8.0), _ttfs("cold", 8.2, 1)],
+                    [_ttfs("warm", 1.0)], []):
+        failures, report = cr.check_ttfs(_goodput(tmp_path, history))
+        assert not failures
+        assert any("neutral" in line for line in report)
+
+
+def test_ttfs_malformed_goodput_fails_loudly(tmp_path):
+    failures, report = cr.check_ttfs(str(tmp_path / "missing.json"))
+    assert failures and any("MALFORMED" in line for line in report)
+    bad = tmp_path / "goodput.json"
+    bad.write_text('{"ttfs_history": [{"mode": "warm", "ttfs_s": "fast"}]}')
+    failures, _ = cr.check_ttfs(str(bad))
+    assert failures and "malformed ttfs_history entry" in failures[0]
+
+
+def test_ttfs_cli_gate(tmp_path):
+    path = _goodput(tmp_path, [_ttfs("cold", 6.0), _ttfs("warm", 1.0, 1)])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "check_regression.py"),
+         "--ttfs", path], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "check_regression.py"),
+         "--ttfs", path, "--ttfs-max-ratio", "0.1"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "REGRESSION ttfs" in proc.stdout

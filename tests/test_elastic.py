@@ -508,3 +508,34 @@ def test_goodput_gate_fails_below_coverage_floor(tmp_path, capsys):
     assert "REGRESSION goodput" in capsys.readouterr().out
     path.write_text("{not json")
     assert _check_regression("--goodput", str(path)) == 1
+
+
+def test_clear_stale_run_id_removes_torn_keeps_healthy(tmp_path, capsys):
+    launch = _launch_module()
+    d = str(tmp_path)
+    path = os.path.join(d, "run_id.json")
+
+    launch.clear_stale_run_id(None)  # no checkpoint dir: no-op
+    launch.clear_stale_run_id(d)  # no file yet: no-op
+
+    # A healthy survivor is the shared identity — never cleared.
+    with open(path, "w") as fh:
+        json.dump({"run_id": "r-abc", "host": "h0"}, fh)
+    launch.clear_stale_run_id(d)
+    assert json.load(open(path))["run_id"] == "r-abc"
+    assert capsys.readouterr().err == ""
+
+    # A torn file (attempt killed mid-write) is cleared LOUDLY, so the
+    # relaunch's rank 0 re-establishes identity instead of poll-reading
+    # its own wreck to the deadline on every restart.
+    with open(path, "w") as fh:
+        fh.write('{"run_id": "r-kil')
+    launch.clear_stale_run_id(d)
+    assert not os.path.exists(path)
+    assert "torn" in capsys.readouterr().err
+
+    # Valid JSON missing the key is just as unusable.
+    with open(path, "w") as fh:
+        json.dump({"host": "h0"}, fh)
+    launch.clear_stale_run_id(d)
+    assert not os.path.exists(path)

@@ -379,3 +379,61 @@ def test_chaos_rank_qualifier_gates_firing(monkeypatch, tmp_path):
     eng2.STALL_S = 0.01
     eng2.batch_hook(0, 2, batch)
     assert eng2.events[0].fired
+
+
+def test_ensure_run_id_reclaims_torn_file_loudly(tmp_path, caplog):
+    d = str(tmp_path)
+    path = os.path.join(d, fleetobs.RUN_ID_FILE)
+    with open(path, "w") as fh:
+        fh.write('{"run_id": "killed-mid-wr')  # torn by a dead attempt
+    with caplog.at_level("ERROR", logger="pdtx"):
+        rid = fleetobs.ensure_run_id(d, "fresh-attempt", rank=0)
+    # Rank 0 reclaims: unlink + exclusive re-create under the new id,
+    # instead of poll-reading its own torn file to the deadline.
+    assert rid == "fresh-attempt"
+    assert json.load(open(path))["run_id"] == "fresh-attempt"
+    assert any("torn" in r.message and "reclaiming" in r.message
+               for r in caplog.records)
+
+
+def test_ensure_run_id_rank_nonzero_times_out_on_torn_file(tmp_path, caplog):
+    d = str(tmp_path)
+    path = os.path.join(d, fleetobs.RUN_ID_FILE)
+    with open(path, "w") as fh:
+        fh.write("not json")
+    with caplog.at_level("ERROR", logger="pdtx"):
+        rid = fleetobs.ensure_run_id(d, "fb", rank=1, timeout_s=0.2)
+    # Rank>0 never creates or reclaims — it falls back per-process, loudly.
+    assert rid == "fb"
+    assert open(path).read() == "not json"
+    assert any("unreadable past" in r.message for r in caplog.records)
+
+
+def test_read_chronic_straggler_streaks_and_resets(tmp_path):
+    path = str(tmp_path / fleetobs.STRAGGLER_FILE)
+
+    def write(rows):
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    flag = lambda rank, flagged=True: {  # noqa: E731
+        "step": 1, "slowest_rank": rank, "flagged": flagged}
+
+    assert fleetobs.read_chronic_straggler(path, 2) is None  # missing file
+
+    # Meta rows (no flagged/slowest_rank keys) are invisible to the streak.
+    write([{"schema_version": 1}, flag(1), flag(1), {"note": "x"}, flag(1)])
+    got = fleetobs.read_chronic_straggler(path, 3)
+    assert got == {"rank": 1, "streak": 3, "rows": 3}
+
+    # An unflagged row resets; so does a culprit change.
+    write([flag(1), flag(1), flag(1, flagged=False), flag(1)])
+    assert fleetobs.read_chronic_straggler(path, 2) is None
+    write([flag(1), flag(1), flag(0), flag(0)])
+    got = fleetobs.read_chronic_straggler(path, 2)
+    assert got == {"rank": 0, "streak": 2, "rows": 4}
+
+    # Streak must be TRAILING: chronic history ended by a clean row is stale.
+    write([flag(1), flag(1), flag(1), flag(1, flagged=False)])
+    assert fleetobs.read_chronic_straggler(path, 3) is None

@@ -1,0 +1,166 @@
+"""Background checkpoint re-shard (core/reshard.py): consolidation
+correctness, CRC quarantine, and the save -> reshard -> restore roundtrip."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_example_tpu.core import (
+    checkpoint as ckpt_lib, mesh as mesh_lib, optim, reshard, train_loop)
+from pytorch_distributed_training_example_tpu.models import registry
+from pytorch_distributed_training_example_tpu.parallel import (
+    sharding as sharding_lib)
+from pytorch_distributed_training_example_tpu.utils.config import Config
+
+
+def _write_step(directory, step=5, *, extra=None, torn_region=False):
+    """Handcraft a committed multi-region checkpoint: one matrix leaf split
+    into two row regions (the second announced via a per-host ``files.p*``
+    sentinel, exercising the same union restore performs) plus a scalar."""
+    step_dir = os.path.join(directory, f"step_{step:08d}")
+    arrays = os.path.join(step_dir, "arrays")
+    os.makedirs(arrays)
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    s = np.float32(7.25)
+    np.save(os.path.join(arrays, "w.p0.0.npy"), w[:2])
+    np.save(os.path.join(arrays, "w.p1.0.npy"), w[2:])
+    np.save(os.path.join(arrays, "s.p0.0.npy"), s)
+
+    def crc(name):
+        return reshard._file_crc32(os.path.join(arrays, name))
+
+    manifest = {
+        "step": step,
+        "extra": dict(extra or {"epoch": 3, "global_batch_size": 16}),
+        "geometry": {"process_count": 2, "device_count": 4},
+        "leaves": {
+            "params/w": {"shape": [4, 3], "dtype": "float32", "files": [
+                {"file": "w.p0.0.npy", "index": [[0, 2], [0, 3]],
+                 "crc32": crc("w.p0.0.npy")}]},
+            "params/s": {"shape": [], "dtype": "float32", "files": [
+                {"file": "s.p0.0.npy", "index": [[0, 0]],
+                 "crc32": crc("s.p0.0.npy")}]},
+        },
+    }
+    with open(os.path.join(step_dir, "files.p1.json"), "w") as fh:
+        json.dump({"params/w": [
+            {"file": "w.p1.0.npy", "index": [[2, 4], [0, 3]],
+             "crc32": crc("w.p1.0.npy")}]}, fh)
+    if torn_region:
+        with open(os.path.join(arrays, "w.p1.0.npy"), "r+b") as fh:
+            fh.seek(0, os.SEEK_END)
+            fh.truncate(fh.tell() - 4)  # host died mid-write
+    with open(os.path.join(step_dir, reshard.MANIFEST_FILE), "w") as fh:
+        json.dump(manifest, fh)
+    with open(os.path.join(step_dir, reshard.COMMIT_FILE), "w") as fh:
+        fh.write(str(step))
+    return step_dir, w, s
+
+
+def test_reshard_consolidates_regions_and_preserves_extra(tmp_path):
+    d = str(tmp_path)
+    step_dir, w, s = _write_step(d, extra={"epoch": 9, "lr": 0.1})
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2"]) == 0
+
+    man = json.load(open(os.path.join(step_dir, reshard.MANIFEST_FILE)))
+    # The saving-geometry record elastic planning reads is untouched...
+    assert man["extra"] == {"epoch": 9, "lr": 0.1}
+    assert man["step"] == 5
+    # ...while the on-disk layout is one contiguous full-leaf file per array.
+    assert man["geometry"] == {"process_count": 1, "device_count": 2}
+    assert man["resharded"] == {
+        "world": 2,
+        "source_geometry": {"process_count": 2, "device_count": 4}}
+    for path, meta in man["leaves"].items():
+        assert len(meta["files"]) == 1, path
+        (entry,) = meta["files"]
+        fpath = os.path.join(step_dir, "arrays", entry["file"])
+        assert reshard._file_crc32(fpath) == entry["crc32"]
+    np.testing.assert_array_equal(
+        np.load(os.path.join(step_dir, "arrays",
+                             man["leaves"]["params/w"]["files"][0]["file"])),
+        w)
+    np.testing.assert_array_equal(
+        np.load(os.path.join(step_dir, "arrays",
+                             man["leaves"]["params/s"]["files"][0]["file"])),
+        s)
+    # Still committed, no attempt/set-aside dirs left behind.
+    assert os.path.exists(os.path.join(step_dir, reshard.COMMIT_FILE))
+    assert sorted(n for n in os.listdir(d) if n.startswith("step_")) == [
+        "step_00000005"]
+
+    # Idempotent: a second pass short-circuits instead of rewriting.
+    before = os.stat(os.path.join(step_dir, reshard.MANIFEST_FILE)).st_mtime_ns
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2"]) == 0
+    after = os.stat(os.path.join(step_dir, reshard.MANIFEST_FILE)).st_mtime_ns
+    assert after == before
+
+
+def test_reshard_quarantines_corrupt_source(tmp_path, caplog):
+    d = str(tmp_path)
+    step_dir, _, _ = _write_step(d, torn_region=True)
+    with caplog.at_level("ERROR", logger="pdtx"):
+        assert reshard.main(["--checkpoint-dir", d, "--world", "1"]) == 4
+    # A torn source must never launder into a fresh-looking copy: the step
+    # is set aside resume-ineligible, and no output was committed.
+    assert not os.path.exists(step_dir)
+    assert os.path.isdir(step_dir + ".corrupt")
+    assert reshard.committed_steps(d) == []
+    assert any("FAILED verification" in r.message for r in caplog.records)
+
+
+def test_reshard_exits_3_when_nothing_committed(tmp_path):
+    d = str(tmp_path)
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2"]) == 3
+    step_dir, _, _ = _write_step(d)
+    os.unlink(os.path.join(step_dir, reshard.COMMIT_FILE))  # uncommitted
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2"]) == 3
+    # An explicit --step that is not committed is refused too.
+    with open(os.path.join(step_dir, reshard.COMMIT_FILE), "w") as fh:
+        fh.write("5")
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2",
+                         "--step", "99"]) == 3
+
+
+def test_reshard_picks_newest_committed_step(tmp_path):
+    d = str(tmp_path)
+    _write_step(d, step=3)
+    step_dir, _, _ = _write_step(d, step=8)
+    assert reshard.committed_steps(d) == [3, 8]
+    assert reshard.main(["--checkpoint-dir", d, "--world", "2"]) == 0
+    assert "resharded" in json.load(
+        open(os.path.join(step_dir, reshard.MANIFEST_FILE)))
+    assert "resharded" not in json.load(
+        open(os.path.join(d, "step_00000003", reshard.MANIFEST_FILE)))
+
+
+def test_save_reshard_restore_roundtrip(tmp_path, devices):
+    """The drill path end to end: an FSDP save is consolidated by the
+    background process, then restored bit-exact at a different topology."""
+    d = str(tmp_path)
+    bundle = registry.create_model("resnet_micro", num_classes=10,
+                                   image_size=32, dtype=jnp.float32,
+                                   param_dtype=jnp.float32)
+    tx, _ = optim.build_optimizer(Config(), steps_per_epoch=10)
+    fsdp_mesh = mesh_lib.build_mesh({"data": 2, "fsdp": 4})
+    state = train_loop.create_train_state(
+        bundle.module, tx, bundle.input_template, fsdp_mesh,
+        sharding_lib.strategy_rules("fsdp", bundle.rules), seed=0)
+    ckpt_lib.Checkpointer(d).save(state, 2, extra={"epoch": 1}, block=True)
+
+    assert reshard.main(["--checkpoint-dir", d, "--world", "8"]) == 0
+
+    dp_mesh = mesh_lib.build_mesh({"data": 8})
+    template = train_loop.create_train_state(
+        bundle.module, tx, bundle.input_template, dp_mesh,
+        sharding_lib.strategy_rules("dp", bundle.rules), seed=99)
+    restored, extra = ckpt_lib.Checkpointer(d).restore(template)
+    assert extra == {"epoch": 1}
+    import jax
+
+    for x, y in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

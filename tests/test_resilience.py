@@ -449,3 +449,50 @@ def test_cli_ckpt_io_error_retries_then_commits(tmp_path):
     err = res.stderr + res.stdout
     assert "retriable io [ckpt_write] failed" in err, err[-3000:]
     assert _committed_steps(ckdir), "injected io errors lost the checkpoint"
+
+
+def test_chaos_slow_host_parse_and_rank_gate():
+    (ev,) = chaos_lib.parse_spec("slow_host@step=4:rank=1")
+    assert (ev.name, ev.key, ev.value, ev.rank) == ("slow_host", "step", 4, 1)
+    with pytest.raises(ValueError):
+        chaos_lib.parse_spec("slow_host@batch=4")
+    with pytest.raises(ValueError):
+        chaos_lib.parse_spec("slow_host")
+
+
+def test_chaos_slow_host_is_chronic_and_logs_once(tmp_path, monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(chaos_lib.time, "sleep", sleeps.append)
+
+    def drive(log_dir, rank):
+        sleeps.clear()
+        eng = chaos_lib.ChaosEngine("slow_host@step=2:rank=1", seed=7,
+                                    log_dir=str(log_dir), rank=rank)
+        eng.steps_per_epoch = SPE
+        batch = {"x": np.ones(2, np.float32)}
+        for g in range(6):
+            out = eng.batch_hook(g // SPE, g % SPE, batch)
+            assert out is batch  # never mutates the data
+        return (log_dir / chaos_lib.CHAOS_LOG).read_text() \
+            if (log_dir / chaos_lib.CHAOS_LOG).exists() else ""
+
+    # Targeted rank: drags EVERY batch from the trip point on (chronic),
+    # but chaos.jsonl records the injection exactly once.
+    d1 = tmp_path / "a"
+    d1.mkdir()
+    log1 = drive(d1, rank=1)
+    assert sleeps == [chaos_lib.ChaosEngine.SLOW_S] * 4  # batches 2..5
+    rows = [json.loads(line) for line in log1.splitlines()]
+    assert len(rows) == 1 and rows[0]["event"] == "slow_host"
+    assert rows[0]["chronic"] is True
+
+    # Same seed + spec -> byte-identical injection log.
+    d2 = tmp_path / "b"
+    d2.mkdir()
+    assert drive(d2, rank=1) == log1
+
+    # Other ranks: untouched, nothing logged.
+    d3 = tmp_path / "c"
+    d3.mkdir()
+    assert drive(d3, rank=0) == ""
+    assert sleeps == []

@@ -506,3 +506,173 @@ def test_fleet_starved_job_fails_the_fleet(tmp_path):
     assert res.returncode == 1, res.stderr
     assert "give up on b" in res.stderr, res.stderr
     assert "'a': 'done'" in res.stderr and "'b': 'failed'" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# straggler-fed eviction (evict_after / evict_decay)
+# ---------------------------------------------------------------------------
+
+
+def _flag(ckdir, step, rank, flagged=True):
+    fleetobs.append_straggler_flag(str(ckdir), {
+        "step": step, "slowest_rank": rank, "delta_s": 0.25,
+        "cause": "input_wait_s", "flagged": flagged, "source": "live"})
+
+
+def test_load_jobs_parses_and_validates_evict_knobs(tmp_path):
+    path = tmp_path / "jobs.json"
+    path.write_text(json.dumps({"pool": 4, "jobs": [
+        {"name": "a", "cmd": ["main.py"], "evict_after": 3,
+         "evict_decay": 5},
+        {"name": "b", "cmd": ["main.py"]},
+    ]}))
+    _, (a, b) = scheduler_lib.load_jobs(str(path))
+    assert (a.evict_after, a.evict_decay) == (3, 5)
+    assert (b.evict_after, b.evict_decay) == (0, 8)  # disabled by default
+    for bad in (
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"],
+                              "evict_after": -1}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"],
+                              "evict_decay": 0}]},
+        {"pool": 2, "jobs": [{"name": "a", "cmd": ["x"], "kind": "serve",
+                              "evict_after": 2}]},
+    ):
+        path.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            scheduler_lib.load_jobs(str(path))
+
+
+def test_straggler_eviction_preempts_marks_dead_and_backfills(tmp_path):
+    ck = tmp_path / "ck_a"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(3, [
+        _spec("a", ckdir=ck, min_world=1, max_world=2, evict_after=3),
+        _spec("b", min_world=1, max_world=1),
+    ], log_dir=str(tmp_path))
+    sched.plan(0.0)
+    for s in range(3):
+        _flag(ck, s, 1)
+    (d,) = sched.plan(1.0)
+    assert d["action"] == "preempt" and d["job"] == "a"
+    # The reason quotes CONFIG (the threshold), never the observed streak —
+    # byte-determinism of placement.jsonl across same-seed drills.
+    assert "flagged 3 consecutive windows" in d["reason"]
+    assert elastic.effective_dead_hosts(str(ck)) == {1}
+    st = sched.state("a")
+    assert st.status == scheduler_lib.PREEMPTING
+    # Graceful exit: requeued, restart budget untouched.
+    row = sched.on_exit("a", 75, 2.0)
+    assert "no budget burned" in row["reason"] and st.restarts == 0
+    (d,) = sched.plan(3.0)
+    assert (d["action"], d["job"], d["world"]) == ("launch", "a", 1)
+
+
+def test_straggler_eviction_requires_fresh_evidence(tmp_path):
+    ck = tmp_path / "ck_a"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("a", ckdir=ck, min_world=1, max_world=2, evict_after=2)])
+    sched.plan(0.0)
+    _flag(ck, 0, 1), _flag(ck, 1, 1)
+    (d,) = sched.plan(1.0)
+    assert d["action"] == "preempt"
+    sched.on_exit("a", 75, 2.0)
+    sched.plan(3.0)  # relaunch
+    # The old flag rows are still on disk; without NEW rows the job must
+    # never be evicted again.
+    assert sched.plan(4.0) == []
+    _flag(ck, 9, 1), _flag(ck, 10, 1)
+    (d,) = sched.plan(5.0)
+    assert d["action"] == "preempt"
+
+
+def test_straggler_eviction_never_shrinks_below_min_world(tmp_path):
+    ck = tmp_path / "ck_a"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("a", ckdir=ck, min_world=2, max_world=2, evict_after=2)])
+    sched.plan(0.0)
+    _flag(ck, 0, 1), _flag(ck, 1, 1)
+    assert sched.plan(1.0) == []  # evicting would leave cap 1 < min 2
+    assert elastic.effective_dead_hosts(str(ck)) == set()
+    assert sched.state("a").status == scheduler_lib.RUNNING
+
+
+def test_straggler_suspicion_decays_and_readmits(tmp_path):
+    ck = tmp_path / "ck_a"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(3, [
+        _spec("a", ckdir=ck, min_world=1, max_world=2, evict_after=2,
+              evict_decay=3),
+        _spec("b", min_world=1, max_world=1, max_restarts=9),
+    ], log_dir=str(tmp_path))
+    sched.plan(0.0)                      # seq 1,2: launches
+    _flag(ck, 0, 1), _flag(ck, 1, 1)
+    sched.plan(1.0)                      # seq 3: preempt a, host 1 dead
+    sched.on_exit("a", 75, 2.0)          # seq 4
+    sched.plan(3.0)                      # seq 5: a backfills at world 1
+    sched.on_exit("b", 1, 4.0)           # seq 6: b fails -> backoff
+    ds = sched.plan(100.0)               # decay due (6 - 3 >= 3)
+    assert [d["action"] for d in ds] == ["readmit", "launch"]
+    assert "suspicion decayed after 3 decisions" in ds[0]["reason"]
+    assert elastic.effective_dead_hosts(str(ck)) == set()
+    assert sched.state("a").suspects == []
+
+
+def test_straggler_eviction_decisions_are_seq_based_not_clocked(tmp_path):
+    # Identical scripted histories -> byte-identical placement logs, no
+    # matter what wall-clock values drive the passes.
+    def drill(log_dir, times):
+        ck = os.path.join(log_dir, "ck_a")
+        os.makedirs(ck)
+        sched = scheduler_lib.FleetScheduler(3, [
+            _spec("a", ckdir=ck, min_world=1, max_world=2, evict_after=2,
+                  evict_decay=2),
+            _spec("b", min_world=1, max_world=1),
+        ], log_dir=log_dir)
+        sched.plan(times[0])
+        _flag(ck, 0, 1), _flag(ck, 1, 1)
+        sched.plan(times[1])
+        sched.on_exit("a", 75, times[2])
+        sched.plan(times[3])
+        sched.on_exit("b", 0, times[4])
+        sched.plan(times[5])
+        sched.on_exit("a", 0, times[6])
+        return open(os.path.join(log_dir,
+                                 scheduler_lib.PLACEMENT_FILE)).read()
+
+    a = drill(str(tmp_path / "a"), [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    b = drill(str(tmp_path / "b"), [10.0, 40.0, 41.5, 90.0, 91.0, 500.0,
+                                    501.0])
+    assert a == b
+    rows = [json.loads(line) for line in a.splitlines()]
+    assert all(set(r) == {"seq", "action", "job", "world", "free", "reason"}
+               for r in rows)
+    assert "readmit" in [r["action"] for r in rows]
+
+
+def test_straggler_eviction_respects_backoff_claims(tmp_path):
+    # An evicted job requeues into the normal placement flow: a higher-
+    # priority job waiting out a backoff keeps its claim, so the evicted
+    # job's relaunch cannot squat on the claimant's minimum.
+    ck = tmp_path / "ck_lo"
+    ck.mkdir()
+    sched = scheduler_lib.FleetScheduler(2, [
+        _spec("lo", ckdir=ck, priority=0, min_world=2, max_world=2,
+              evict_after=2),
+        _spec("hi", priority=9, min_world=2, max_world=2, backoff_s=50.0),
+    ], log_dir=str(tmp_path))
+    sched.plan(0.0)                     # hi takes the pool
+    sched.on_exit("hi", 1, 1.0)         # hi -> backoff until 51.0
+    sched.plan(2.0)                     # lo launches at 2 meanwhile?
+    # lo cannot launch under hi's claim (claim = hi's min 2 = whole pool).
+    assert sched.state("lo").status == scheduler_lib.PENDING
+    sched.plan(51.0)                    # hi relaunches
+    assert sched.state("hi").status == scheduler_lib.RUNNING
+    sched.on_exit("hi", 0, 52.0)
+    sched.plan(53.0)                    # lo finally launches at 2
+    assert sched.state("lo").status == scheduler_lib.RUNNING
+    _flag(ck, 0, 1), _flag(ck, 1, 1)
+    # min_world 2 and pool 2: eviction would pin lo below its minimum.
+    assert sched.plan(54.0) == []
+    assert sched.state("lo").status == scheduler_lib.RUNNING

@@ -369,3 +369,37 @@ def test_check_regression_flags_nonfinite_health(tmp_path):
     failures, report = cr.check_health(str(p))
     assert failures and "update_norm" in failures[0]
     assert any(line.startswith("NON-FINITE") for line in report)
+
+
+def test_span_recorder_ttfs_and_restart_breakdown(tmp_path):
+    rec = telemetry_lib.SpanRecorder(run_id="r1")
+    with rec.span("compile"):
+        time.sleep(0.01)
+    with rec.span("step"):
+        time.sleep(0.005)
+    rec.mark_first_step("cold")
+    rec.mark_first_step("warm")  # later calls are no-ops: TTFS is ONE number
+    g1 = rec.goodput()
+    assert g1["ttfs_mode"] == "cold"
+    assert g1["time_to_first_step_s"] >= 0.01
+    assert g1["ttfs_history"] == [{"attempt": 1, "mode": "cold",
+                                   "ttfs_s": g1["time_to_first_step_s"]}]
+    assert "restart_breakdown" not in g1  # no restart gap yet
+
+    # Attempt 2 carries attempt 1's goodput: history accumulates and the
+    # restart gap is decomposed into the three costs r21 exists to shrink.
+    time.sleep(0.02)  # a measurable supervisor gap past ended_at's rounding
+    rec2 = telemetry_lib.SpanRecorder(run_id="r1", carry=g1)
+    with rec2.span("checkpoint_restore"):
+        time.sleep(0.005)
+    with rec2.span("step"):
+        pass
+    rec2.mark_first_step("warm")
+    g2 = rec2.goodput()
+    assert g2["attempts"] == 2
+    assert [h["mode"] for h in g2["ttfs_history"]] == ["cold", "warm"]
+    assert g2["ttfs_history"][1]["attempt"] == 2
+    bd = g2["restart_breakdown"]
+    assert bd["gap_s"] > 0.0  # the supervisor gap between the attempts
+    assert bd["restore_s"] >= 0.005
+    assert set(bd) == {"gap_s", "compile_s", "restore_s"}
