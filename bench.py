@@ -526,7 +526,7 @@ def main(argv=None):
                    help="MoE expert capacity factor (llama_moe rows)")
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "xla", "flash", "ring", "ring_zigzag",
-                            "ulysses"])
+                            "ring_allgather", "ulysses"])
     p.add_argument("--telemetry", action="store_true",
                    help="compile the on-device health pack into the step "
                         "(utils/telemetry.py) — measures its overhead vs "

@@ -304,12 +304,21 @@ def check_slo(path: str):
 def aot_key(result: dict) -> str:
     """Golden key for an aot_report: model + shape + dispatch formulation.
     EP rows (lowered at an expert mesh) extend the key with the degree and
-    transport so replicated/a2a/a2a_overlap goldens coexist per shape."""
+    transport so replicated/a2a/a2a_overlap goldens coexist per shape;
+    composed-topology rows (r22) append dp/pp/seq tokens when those axes
+    are in the mesh, so every golden row is one (dp, ep, pp, seq) tuple.
+    Single-axis rows keep their historical keys unchanged."""
     key = (f"{result['model']} b{result['per_chip_batch']} "
            f"s{result['seq_len']} {result.get('moe_dispatch_impl', '-')}")
     if int(result.get("ep_degree", 1) or 1) > 1:
         key += (f" ep{result['ep_degree']} "
                 f"{result.get('moe_ep_dispatch', 'replicated')}")
+    if int(result.get("dp_degree", 0) or 0) > 1:
+        key += f" dp{result['dp_degree']}"
+    if int(result.get("pp_degree", 1) or 1) > 1:
+        key += f" pp{result['pp_degree']}"
+    if int(result.get("seq_degree", 1) or 1) > 1:
+        key += f" seq{result['seq_degree']}"
     return key
 
 
@@ -349,6 +358,48 @@ def check_aot_bytes(result: dict, golden: dict, tolerance: float = 0.10):
             report.append("REGRESSION " + line)
         else:
             report.append("OK " + line)
+    # Memory census (r22): the abstract lowering's per-device high-water
+    # regresses UPWARD like traffic. Only temps + resident are gated —
+    # argument bytes are a function of the param count and sharding, which
+    # the regions gate already pins transitively.
+    mem = result.get("memory")
+    ref_mem = entry.get("memory")
+    if mem and ref_mem:
+        for field in ("temp_bytes", "resident_bytes"):
+            if ref_mem.get(field) is None or mem.get(field) is None:
+                continue
+            val, ref = float(mem[field]), float(ref_mem[field])
+            ratio = val / ref if ref else (float("inf") if val else 1.0)
+            line = (f"aot_memory {field} ({key}): {val / 1e6:.1f} MB vs "
+                    f"golden {ref / 1e6:.1f} MB ({ratio:.2%})")
+            if ratio > 1.0 + tolerance:
+                failures.append(line)
+                report.append("REGRESSION " + line)
+            else:
+                report.append("OK " + line)
+    # Sequence-parallel shrink gate (r22): the point of the context axis is
+    # that per-device activation temps scale ~1/seq (ring attention never
+    # materializes the full [S, S] score block and every residual tensor is
+    # [B, S/seq, d]). A seq row must undercut its seq=1 sibling golden by at
+    # least half the ideal scaling — val * seq <= ref * 2.0 — or the sharded
+    # lowering has stopped paying for its collectives.
+    seq = int(result.get("seq_degree", 1) or 1)
+    if mem and seq > 1:
+        sib_key = aot_key({**result, "seq_degree": 1})
+        sib = golden.get("aot_regions", {}).get(sib_key, {}).get("memory")
+        if sib is None or sib.get("temp_bytes") is None:
+            report.append(f"NO-GOLDEN aot_regions[{sib_key}]: record the "
+                          "seq=1 sibling to arm the seq-shrink gate")
+        else:
+            val, ref = float(mem["temp_bytes"]), float(sib["temp_bytes"])
+            line = (f"aot_seq_shrink ({key}): temp bytes {val / 1e6:.1f} MB "
+                    f"x seq{seq} vs seq1 golden {ref / 1e6:.1f} MB")
+            if val * seq > ref * 2.0:
+                failures.append(line + " — per-device activation temps no "
+                                "longer shrink ~1/seq")
+                report.append("REGRESSION " + line)
+            else:
+                report.append("OK " + line)
     # EP comms model (r17): collective moe bytes regress upward like any
     # traffic number, and an a2a row must also UNDERCUT its replicated
     # sibling golden at the same shape/degree — the whole point of sharding
@@ -401,6 +452,8 @@ def record_aot_golden(result: dict, path: str = GOLDEN_PATH) -> str:
     }
     if result.get("xla_flops_per_step") is not None:
         entry["xla_flops_per_step"] = result["xla_flops_per_step"]
+    if result.get("memory"):
+        entry["memory"] = dict(result["memory"])
     coll = result.get("collectives")
     if coll:
         entry["collectives"] = {

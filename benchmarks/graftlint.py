@@ -33,12 +33,18 @@ real bench program:
          (the r10 router-leak class, keyed on ``jax.named_scope`` tags).
   GL103  device-to-host transfers (host callbacks / outfeed) baked into
          the compiled step.
-  GL104  sharding-constraint coverage per named-scope region.
-  GL105  unattributable all-to-all: every ``all-to-all`` in the compiled
-         step must carry a sanctioned named-scope tag (``moe_*`` for the
-         EP dropless transport, ``attn_ulysses_a2a`` for Ulysses) in its
-         op_name metadata — an untagged a2a evades the EP comms census
-         (``--aot-bytes``) and the per-region profile rollups.
+  GL104  sharding-constraint coverage per named-scope region; on a
+         context>1 mesh the census also counts sequence-dim constraints
+         (zero seq anchors at such a mesh is an error).
+  GL105  unattributable point-to-point collectives: every ``all-to-all``
+         (sanctioned scopes: ``moe_*`` for the EP dropless transport,
+         ``attn_ulysses_a2a`` for Ulysses) and every
+         ``collective-permute`` (``attn_ring_ppermute`` for the ring
+         K/V rotation, ``pp_stage_shift`` for the GPipe hop, ``moe_*``
+         for the EP ppermute fallback) in the compiled step must carry
+         a sanctioned named-scope tag in its op_name metadata — an
+         untagged collective evades the comms census (``--aot-bytes``)
+         and the per-region profile rollups.
 
 Findings are machine-readable (``--json``) and gated against a reviewed
 suppression baseline (``benchmarks/lint_baseline.json``); each suppression
@@ -85,6 +91,16 @@ MOE_TAG_RE = re.compile(
 A2A_SCOPE_RE = re.compile(
     r"\b(?:moe_(?:router|dispatch|experts_gmm|experts|combine|aux)"
     r"|attn_ulysses_a2a)\b")
+
+# Scopes sanctioned to issue collective-permute (GL105, r22): the ring /
+# zigzag K-V rotation and output un-permute (``attn_ring_ppermute``,
+# ops/attention.py), the GPipe stage hop (``pp_stage_shift``,
+# parallel/pipeline.py), and the moe_* EP ppermute fallback transport.
+# ``attn_ring_allgather`` (the ring's dense fallback) rides along so an
+# attention-site gather stays census-attributable too.
+CPERM_SCOPE_RE = re.compile(
+    r"\b(?:moe_(?:router|dispatch|experts_gmm|experts|combine|aux)"
+    r"|attn_ring_ppermute|attn_ring_allgather|pp_stage_shift)\b")
 
 
 def _norm(s: str) -> str:
@@ -1051,7 +1067,7 @@ def _ir_host_transfer(hlo, label) -> list[Finding]:
     return out
 
 
-def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
+def _ir_sharding(asm, label, expect_sharding, seq_axis=False) -> list[Finding]:
     locs: dict[str, str] = {}
     for m in re.finditer(r"#loc(\d+) = loc\(\"([^\"]+)\"", asm):
         locs[m.group(1)] = m.group(2)
@@ -1061,6 +1077,7 @@ def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
             locs.setdefault(m.group(1), locs[m.group(2)])
     counts: dict[str, int] = {}
     total = 0
+    seq_total = 0
     for m in re.finditer(
         r"stablehlo\.custom_call\s+@Sharding.*?loc\(#loc(\d+)\)", asm
     ):
@@ -1069,7 +1086,33 @@ def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
         tag = MOE_TAG_RE.search(scope_s)
         region = tag.group(0) if tag else "untagged"
         counts[region] = counts.get(region, 0) + 1
+        # Sequence-axis census (r22): a constraint splitting dim 1 of a
+        # rank>=3 operand is anchoring the [B, S, ...] sequence dim — on a
+        # context>1 mesh that's the seq/context axis (plus "model" when the
+        # Megatron-SP fold is on). devices=[a,b,...] lists the per-dim tile
+        # factors in dim order, so dim 1's factor is the second entry.
+        dev = re.search(r'mhlo\.sharding = "[^"]*devices=\[(\d+),(\d+)',
+                        m.group(0))
+        rank = re.search(r"tensor<(?:\d+x){3,}", m.group(0))
+        if dev and rank and int(dev.group(2)) > 1:
+            seq_total += 1
     out: list[Finding] = []
+    if seq_axis and total and seq_total == 0:
+        out.append(
+            Finding(
+                rule="GL104",
+                path=f"<ir:{label}>",
+                line=0,
+                scope="sharding",
+                message=(
+                    "mesh has a context axis but no sharding constraint "
+                    "splits the sequence dim — activations are unanchored "
+                    "on seq; propagation may replicate [B, S, d] residuals "
+                    "(wire the models' seq_rules constrain sites)"
+                ),
+                snippet="seq-constraints=0",
+            )
+        )
     if total == 0 and expect_sharding:
         out.append(
             Finding(
@@ -1087,6 +1130,8 @@ def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
         )
     else:
         detail = ", ".join(f"{k}={v}" for k, v in sorted(counts.items())) or "none"
+        if seq_axis:
+            detail += f", seq-dim={seq_total}"
         out.append(
             Finding(
                 rule="GL104",
@@ -1102,46 +1147,60 @@ def _ir_sharding(asm, label, expect_sharding) -> list[Finding]:
 
 
 _A2A_LINE_RE = re.compile(r"= (?:\([^)]*\)|\S+) all-to-all(?:-start)?\(")
+_CPERM_LINE_RE = re.compile(
+    r"= (?:\([^)]*\)|\S+) collective-permute(?:-start)?\(")
 
 
 def _ir_a2a_scope(hlo, label) -> list[Finding]:
-    """GL105: all-to-all instructions outside sanctioned named scopes.
+    """GL105: point-to-point collectives outside sanctioned named scopes.
 
-    The EP comms census (profile_step.collective_byte_census) and the
-    PROFILE_MOE region rollups attribute a2a traffic by named-scope tag;
-    an a2a issued outside ``moe_*`` / ``attn_ulysses_a2a`` scopes lands in
-    ``non_moe`` where the --aot-bytes golden never gates it. -done halves
-    are skipped (same instruction, counted once at -start or the sync op).
+    The comms census (profile_step.collective_byte_census) and the
+    PROFILE_MOE region rollups attribute traffic by named-scope tag; a
+    collective issued outside a sanctioned scope lands in ``non_moe``
+    where the --aot-bytes golden never gates it. Two opcodes are policed:
+    ``all-to-all`` (sanctioned: ``moe_*`` EP transport,
+    ``attn_ulysses_a2a``) and, since the ring/pipeline axes (r22),
+    ``collective-permute`` (sanctioned: ``attn_ring_ppermute``,
+    ``pp_stage_shift``, ``moe_*`` ppermute fallback). All-gather is NOT
+    policed — GSPMD's FSDP weight gathers are legitimately everywhere —
+    but the ring's dense fallback tags its gathers ``attn_ring_allgather``
+    so they classify. -done halves are skipped (same instruction, counted
+    once at -start or the sync op).
     """
     out: list[Finding] = []
     seen: set[str] = set()
+    policed = (("all-to-all", _A2A_LINE_RE, A2A_SCOPE_RE,
+                "jax.named_scope('moe_dispatch'/'attn_ulysses_a2a')"),
+               ("collective-permute", _CPERM_LINE_RE, CPERM_SCOPE_RE,
+                "jax.named_scope('attn_ring_ppermute'/'pp_stage_shift')"))
     for line in hlo.splitlines():
-        if not _A2A_LINE_RE.search(line):
-            continue
-        op = re.search(r'op_name="([^"]+)"', line)
-        op_name = op.group(1) if op else ""
-        if op_name and A2A_SCOPE_RE.search(op_name):
-            continue
-        key = _norm(op_name) or "<no-op_name>"
-        if key in seen:
-            continue
-        seen.add(key)
-        out.append(
-            Finding(
-                rule="GL105",
-                path=f"<ir:{label}>",
-                line=0,
-                scope="a2a-scope",
-                message=(
-                    "all-to-all outside sanctioned named scopes "
-                    f"(op {op_name or '<untagged>'}) — wrap the call site "
-                    "in jax.named_scope('moe_dispatch'/'attn_ulysses_a2a') "
-                    "so the EP comms census and region rollups can "
-                    "attribute its bytes"
-                ),
-                snippet=f"a2a {key}",
+        for opcode, line_re, scope_re, hint in policed:
+            if not line_re.search(line):
+                continue
+            op = re.search(r'op_name="([^"]+)"', line)
+            op_name = op.group(1) if op else ""
+            if op_name and scope_re.search(op_name):
+                continue
+            key = f"{opcode} " + (_norm(op_name) or "<no-op_name>")
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Finding(
+                    rule="GL105",
+                    path=f"<ir:{label}>",
+                    line=0,
+                    scope="a2a-scope",
+                    message=(
+                        f"{opcode} outside sanctioned named scopes "
+                        f"(op {op_name or '<untagged>'}) — wrap the call "
+                        f"site in {hint} so the comms census and region "
+                        "rollups can attribute its bytes"
+                    ),
+                    snippet=key if opcode != "all-to-all"
+                    else f"a2a {_norm(op_name) or '<no-op_name>'}",
+                )
             )
-        )
     return out
 
 
@@ -1154,8 +1213,12 @@ def lint_lowered(
     upcast_bytes: int = 1 << 20,
     donation_slack: float = 0.01,
     expect_sharding: bool | None = None,
+    seq_axis: bool = False,
 ) -> list[Finding]:
-    """IR rules on an already-lowered jitted step (test-facing hook)."""
+    """IR rules on an already-lowered jitted step (test-facing hook).
+
+    ``seq_axis=True`` (a context>1 mesh) arms GL104's sequence-dim census:
+    zero seq-splitting constraints at such a mesh is an error."""
     compiled = lowered.compile()
     hlo = compiled.as_text()
     findings: list[Finding] = []
@@ -1172,7 +1235,8 @@ def lint_lowered(
     except Exception:
         asm = ""
     if asm:
-        findings += _ir_sharding(asm, label, bool(expect_sharding))
+        findings += _ir_sharding(asm, label, bool(expect_sharding),
+                                 seq_axis=seq_axis)
     return findings
 
 
@@ -1209,6 +1273,7 @@ def run_ir(
             upcast_bytes=upcast_bytes,
             donation_slack=donation_slack,
             expect_sharding=built["mesh"].size > 1,
+            seq_axis=built["mesh"].shape.get("context", 1) > 1,
         )
 
 

@@ -639,7 +639,8 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
                         moe_router_impl="reference",
                         moe_ep_dispatch="replicated",
                         moe_ep_overlap_chunks=2,
-                        mesh_spec: dict | None = None):
+                        mesh_spec: dict | None = None,
+                        pp_microbatches=4):
     """Chipless abstract train step: the shared lowering front-end.
 
     Builds the SAME program ``bench.setup_step`` times — same registry
@@ -694,8 +695,19 @@ def build_abstract_step(model_name: str, *, per_chip_batch=4,
                                    moe_ep_overlap_chunks=moe_ep_overlap_chunks,
                                    logits_dtype=policy.logits_dtype)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=1000)
-    rules = sharding_lib.strategy_rules(strategy, bundle.rules)
-    module = bundle.module
+    if strategy == "pp":
+        # Pipeline rows reuse the trainer's wiring: scan-stacked Llama
+        # blocks sharded over 'stage', GPipe microbatch schedule
+        # (parallel/pp_lm.py). The wrapper quacks like a flax module, so
+        # the abstract lowering below is unchanged.
+        from pytorch_distributed_training_example_tpu.parallel import pp_lm
+
+        module = pp_lm.PipelinedLlama(bundle.module, mesh,
+                                      num_microbatches=pp_microbatches)
+        rules = pp_lm.PP_RULES
+    else:
+        rules = sharding_lib.strategy_rules(strategy, bundle.rules)
+        module = bundle.module
 
     def init_fn(rng):
         variables = module.init({"params": rng}, *jax.tree.map(
@@ -735,7 +747,8 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
                moe_dispatch_impl="gather", moe_combine_dtype="fp32",
                moe_router_dtype="fp32", moe_router_impl="reference",
                moe_ep_dispatch="replicated", moe_ep_overlap_chunks=2,
-               ep_degree=1):
+               ep_degree=1, seq_degree=1, pp_degree=1, dp_degree=0,
+               pp_microbatches=4):
     """Chipless per-region program report (the derived leg of PROFILE_MOE.md).
 
     AOT-lowers the SAME train step bench.py times — same registry model,
@@ -770,11 +783,29 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
     pins ``moe/experts/w_*`` to the expert axis) and the ``collectives``
     census becomes the EP comms model: per-opcode/per-region bytes that
     the a2a-vs-replicated golden rows gate (``check_regression.py
-    --aot-bytes``)."""
+    --aot-bytes``).
+
+    ``seq_degree`` / ``pp_degree`` / ``dp_degree`` compose the full
+    topology tuple (dp x ep x pp x seq): the mesh gains a ``context`` /
+    ``stage`` axis and the report becomes the per-topology memory+comms
+    census — ring-attention ppermute bytes land in the collectives
+    census, and ``memory`` carries the abstract lowering's HBM high-water
+    (``compiled.memory_analysis()``: resident = arguments + temps under
+    donation). ``pp_degree > 1`` forces strategy "pp" (the GPipe schedule
+    over scan-stacked Llama blocks). ``dp_degree == 0`` lets the data
+    axis absorb the remaining devices (the historical single-axis
+    behavior); setting it pins the data axis so one report is one
+    (dp, ep, pp, seq) tuple."""
     mesh_spec = None
-    if ep_degree > 1:
-        mesh_spec = {"expert": ep_degree, "data": -1}
-        strategy = strategy or "fsdp_tp"
+    if ep_degree > 1 or seq_degree > 1 or pp_degree > 1 or dp_degree:
+        mesh_spec = {a: d for a, d in (("expert", ep_degree),
+                                       ("context", seq_degree),
+                                       ("stage", pp_degree)) if d > 1}
+        mesh_spec["data"] = dp_degree if dp_degree else -1
+        if pp_degree > 1:
+            strategy = "pp"
+        elif ep_degree > 1:
+            strategy = strategy or "fsdp_tp"
     built = build_abstract_step(
         model_name, per_chip_batch=per_chip_batch, precision=precision,
         seq_len=seq_len, strategy=strategy, remat=remat,
@@ -786,7 +817,7 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         moe_router_impl=moe_router_impl,
         moe_ep_dispatch=moe_ep_dispatch,
         moe_ep_overlap_chunks=moe_ep_overlap_chunks,
-        mesh_spec=mesh_spec)
+        mesh_spec=mesh_spec, pp_microbatches=pp_microbatches)
     import jax
 
     from pytorch_distributed_training_example_tpu.core import (
@@ -834,6 +865,25 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         ca = {}
     if isinstance(ca, list):  # older jax: one dict per program
         ca = ca[0] if ca else {}
+    # Per-device HBM high-water of the abstract lowering. Under donation the
+    # resident set is arguments + temps (outputs alias donated inputs), which
+    # is what the v5p 95 GB budget gates in FEASIBILITY_8B.json. This is the
+    # host backend's buffer assignment — CPU temps run ~2x the TPU assignment
+    # at 8B scale (no fusion of the attention softmax), so consumers compare
+    # rows against rows, never against the raw chip budget.
+    memory = None
+    try:
+        ma = compiled.memory_analysis()
+        memory = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "resident_bytes": int(ma.argument_size_in_bytes
+                                  + ma.temp_size_in_bytes),
+        }
+    except Exception:
+        pass
     return {
         "mode": "aot_hlo_model",
         "attribution": "proportional_bytes",
@@ -852,8 +902,13 @@ def aot_report(model_name: str, *, per_chip_batch=4, precision="bf16",
         "moe_ep_dispatch": moe_ep_dispatch,
         "moe_ep_overlap_chunks": moe_ep_overlap_chunks,
         "ep_degree": ep_degree,
+        "seq_degree": seq_degree,
+        "pp_degree": pp_degree,
+        "dp_degree": dp_degree,
+        "attn_impl": attn_impl,
         "xla_flops_per_step": ca.get("flops"),
         "xla_bytes_accessed": ca.get("bytes accessed"),
+        "memory": memory,
         "collectives": collective_byte_census(hlo_text),
         "regions": dict(sorted(regions.items(),
                                key=lambda kv: -kv[1]["gbytes_modeled"])),
@@ -895,6 +950,21 @@ def main(argv=None):
                    help="expert-parallel degree for --aot: lower at an "
                         "{expert: N, data: rest} mesh (forces N fake CPU "
                         "host devices when run chipless)")
+    p.add_argument("--seq-par", type=int, default=1, dest="seq_par",
+                   help="sequence/context-parallel degree for --aot: the "
+                        "mesh gains a context axis; pair with "
+                        "--attn-impl ring for the sharded-KV lowering")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree for --aot: wraps the "
+                        "model in the GPipe schedule over a stage axis "
+                        "(llama family, layers %% stages == 0)")
+    p.add_argument("--dp", type=int, default=0,
+                   help="pin the data axis for --aot (0 = absorb the "
+                        "remaining devices); with --ep/--pp/--seq-par one "
+                        "report is one (dp, ep, pp, seq) topology tuple")
+    p.add_argument("--pp-microbatches", type=int, default=4,
+                   dest="pp_microbatches",
+                   help="GPipe microbatch count when --pp > 1")
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--telemetry", action="store_true",
@@ -908,12 +978,13 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="write full JSON here")
     args = p.parse_args(argv)
     if args.aot:
-        if args.ep > 1 and "jax" not in sys.modules:
-            # Chipless EP lowering needs ep addressable devices; must land
-            # before the first jax import in this process.
+        ndev = max(args.dp, 1) * args.ep * args.seq_par * args.pp
+        if ndev > 1 and "jax" not in sys.modules:
+            # Chipless composed-mesh lowering needs dp*ep*pp*seq addressable
+            # devices; must land before the first jax import in this process.
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "") +
-                f" --xla_force_host_platform_device_count={args.ep}")
+                f" --xla_force_host_platform_device_count={ndev}")
         res = aot_report(args.model, per_chip_batch=args.per_chip_batch,
                          precision=args.precision, seq_len=args.seq_len,
                          strategy=args.strategy, remat=args.remat,
@@ -927,7 +998,9 @@ def main(argv=None):
                          moe_router_impl=args.moe_router_impl,
                          moe_ep_dispatch=args.moe_ep_dispatch,
                          moe_ep_overlap_chunks=args.moe_ep_overlap_chunks,
-                         ep_degree=args.ep)
+                         ep_degree=args.ep, seq_degree=args.seq_par,
+                         pp_degree=args.pp, dp_degree=args.dp,
+                         pp_microbatches=args.pp_microbatches)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(res, f, indent=1)

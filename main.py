@@ -47,7 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dp | fsdp | model-specific (e.g. fsdp_tp)")
     p.add_argument("--mesh", default=None,
                    help="axis sizes as k=v pairs, e.g. 'data=2,fsdp=4' "
-                        "(-1 absorbs remaining devices)")
+                        "(-1 absorbs remaining devices; aliases seq/cp/tp/"
+                        "ep/pp map to context/model/expert/stage)")
+    p.add_argument("--mesh-seq", type=int, default=None, dest="mesh_context",
+                   help="sequence/context-parallel degree (shorthand for "
+                        "--mesh seq=N; ring attention shards S over it)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="gradient checkpointing")
     p.add_argument("--remat-policy", default=None, dest="remat_policy",
@@ -59,9 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient-accumulation microbatches per step")
     p.add_argument("--attn-impl", default=None,
                    choices=["auto", "xla", "flash", "ring", "ring_zigzag",
-                            "ulysses"],
+                            "ring_allgather", "ulysses"],
                    help="attention kernel: Pallas flash, ring (context-"
-                        "parallel), Ulysses all-to-all, or plain XLA")
+                        "parallel ppermute; ring_allgather = all-gather-KV "
+                        "fallback), Ulysses all-to-all, or plain XLA")
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--moe-top-k", type=int, default=None, dest="moe_top_k",
                    help="experts routed per token (llama_moe family)")
@@ -318,7 +323,10 @@ def config_from_args(args) -> "Config":
                  if k in field_names and v is not None}
     cfg = cfg.replace(**overrides)
     if args.mesh:
-        axes = dict(kv.split("=") for kv in args.mesh.split(","))
+        from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
+
+        axes = mesh_lib.normalize_axes(
+            dict(kv.split("=") for kv in args.mesh.split(",")))
         cfg = cfg.replace(**{f"mesh_{k}": int(v) for k, v in axes.items()})
     return cfg
 

@@ -42,6 +42,32 @@ AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "model")
 #: axes from the input pipeline's point of view).
 BATCH_AXES: tuple[str, ...] = ("data", "fsdp")
 
+#: Spelling aliases accepted in mesh-spec dicts (CLI ``--mesh seq=4``,
+#: SNIPPETS.md [3]'s rules vocabulary). The canonical axis names stay AXES —
+#: aliases are normalized before MeshConfig is built so every downstream
+#: consumer (rule tables, shard_map axis names, the AOT census) sees one
+#: spelling.
+AXIS_ALIASES: dict[str, str] = {"seq": "context", "cp": "context",
+                                "tp": "model", "ep": "expert",
+                                "pp": "stage"}
+
+
+def normalize_axes(spec: dict) -> dict:
+    """Map aliased axis names in a mesh-spec dict onto the canonical AXES.
+
+    Raises when an alias and its canonical name are both given (ambiguous
+    intent beats a silent override).
+    """
+    out: dict = {}
+    for key, val in spec.items():
+        canon = AXIS_ALIASES.get(key, key)
+        if canon in out:
+            raise ValueError(
+                f"mesh spec names axis {canon!r} twice (via {key!r}); "
+                f"aliases: {AXIS_ALIASES}")
+        out[canon] = val
+    return out
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
@@ -167,7 +193,7 @@ def build_mesh(
     if config is None:
         config = MeshConfig()
     elif isinstance(config, dict):
-        config = MeshConfig(**config)
+        config = MeshConfig(**normalize_axes(config))
     if devices is None:
         devices = jax.devices()
     devices = list(devices)

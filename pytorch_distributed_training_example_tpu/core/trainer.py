@@ -175,27 +175,16 @@ class Trainer:
                 f"--batch-size {cfg.global_batch_size} must be divisible by the "
                 f"data-parallel degree {dp} (mesh data x fsdp); e.g. use "
                 f"{(cfg.global_batch_size // dp + 1) * dp}")
-        if nproc <= dp:
-            if cfg.global_batch_size % max(nproc, 1):
-                raise ValueError(
-                    "global batch size must divide evenly across hosts")
-            loader_shards, loader_rank = nproc, jax.process_index()
-        else:
-            # Model/expert-parallel-only hosts (dp < process count): the
-            # batch dim replicates across some or all processes, and
-            # make_array_from_process_local_data assumes every process in a
-            # replica group supplies IDENTICAL rows. Shard the sample stream
-            # by the process's data-parallel coordinate (device order is
-            # dp-major), not its process index — otherwise each host feeds
-            # its own rows into a "replicated" array and devices silently
-            # compute on inconsistent copies.
-            if nproc % dp:
-                raise ValueError(
-                    f"process count {nproc} must be a multiple of the "
-                    f"data-parallel degree {dp} (mesh data x fsdp) so every "
-                    "host maps to one dp replica group")
-            loader_shards = dp
-            loader_rank = jax.process_index() * dp // nproc
+        if nproc <= dp and cfg.global_batch_size % max(nproc, 1):
+            raise ValueError(
+                "global batch size must divide evenly across hosts")
+        # Shard the sample stream by the process's data-parallel COORDINATE
+        # (loader.dp_shard): with seq/pp/ep/tp axes in the mesh, processes
+        # sharing a dp coordinate must feed identical rows — otherwise each
+        # host feeds its own rows into a "replicated" array and devices
+        # silently compute on inconsistent copies.
+        loader_shards, loader_rank = loader_lib.dp_shard(
+            nproc, dp, jax.process_index())
         if cfg.grad_accum_steps > 1 and cfg.global_batch_size % (
                 dp * cfg.grad_accum_steps):
             raise ValueError(

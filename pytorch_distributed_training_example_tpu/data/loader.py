@@ -28,6 +28,33 @@ from pytorch_distributed_training_example_tpu.data.sampler import ShardedSampler
 # jax reports more than one process.
 INDEX_LOG_ENV = "PDTX_INDEX_LOG"
 
+def dp_shard(nproc: int, dp: int, process_index: int) -> tuple[int, int]:
+    """Loader (shards, rank) for a host in a gang with non-data axes in the
+    mesh — the DistributedSampler coordinate contract.
+
+    A process must feed rows for its **data-parallel coordinate**, not its
+    process index: with seq/pp/ep/tp axes in the mesh the batch dim
+    replicates across some or all processes, and
+    ``make_array_from_process_local_data`` assumes every process in a
+    replica group supplies IDENTICAL rows. Device order is dp-major, so the
+    ``nproc / dp`` processes holding one dp coordinate form a contiguous
+    run of process indices — e.g. a 2-process dp1 x seq2 gang maps both
+    ranks to coordinate 0 and they read the SAME sample stream.
+
+    ``nproc <= dp`` is the plain multi-host data-parallel case (each host
+    feeds its own slice); otherwise ``nproc`` must be a multiple of ``dp``
+    so every host maps to exactly one dp replica group.
+    """
+    if nproc <= dp:
+        return nproc, process_index
+    if nproc % dp:
+        raise ValueError(
+            f"process count {nproc} must be a multiple of the data-parallel "
+            f"degree {dp} (mesh data x fsdp) so every host maps to one dp "
+            "replica group")
+    return dp, process_index * dp // nproc
+
+
 # Process-wide yield-time hook: ``hook(epoch, batch_idx, batch) -> batch``,
 # applied by every loader (python and native paths) right after index
 # logging. The chaos harness (utils/chaos.py) uses it to poison or stall

@@ -25,15 +25,17 @@ from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
+from pytorch_distributed_training_example_tpu.parallel import sharding
 
 BATCH = mesh_lib.BATCH_AXES
 
 
-def _seq_axes(sp: bool):
-    """Sequence-dim sharding for the residual stream: with Megatron-style SP
-    on, the sequence also shards over the TP axis between matmul regions
-    (GSPMD inserts the gather/scatter Megatron's SP does by hand)."""
-    return ("context", "model") if sp else "context"
+def _seq_rule(name: str, sp: bool = False):
+    """Sequence/context activation spec from the shared rule table
+    (parallel/sharding.seq_rules): with Megatron-style SP on, the residual
+    stream's sequence dim also shards over the TP axis between matmul
+    regions (GSPMD inserts the gather/scatter Megatron's SP does by hand)."""
+    return sharding.seq_rules(sp)[name]
 
 
 class SelfAttention(nn.Module):
@@ -51,9 +53,9 @@ class SelfAttention(nn.Module):
             (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
             param_dtype=self.param_dtype, name=name)
         q, k, v = dg("query")(x), dg("key")(x), dg("value")(x)
-        q = mesh_lib.constrain(q, P(BATCH, "context", "model", None))
-        k = mesh_lib.constrain(k, P(BATCH, "context", "model", None))
-        v = mesh_lib.constrain(v, P(BATCH, "context", "model", None))
+        q = mesh_lib.constrain(q, _seq_rule("qkv"))
+        k = mesh_lib.constrain(k, _seq_rule("qkv"))
+        v = mesh_lib.constrain(v, _seq_rule("qkv"))
         out = attn_lib.attention(q, k, v, causal=True, impl=self.attn_impl)
         out = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
                               param_dtype=self.param_dtype, name="out")(out)
@@ -78,19 +80,19 @@ class Block(nn.Module):
         x = x + SelfAttention(self.num_heads, self.dtype, self.param_dtype,
                               self.dropout, self.attn_impl,
                               name="attn")(ln("ln_1")(x), train)
-        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        x = mesh_lib.constrain(x, _seq_rule("residual", self.sp))
         h = ln("ln_2")(x)
         d = x.shape[-1]
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlp_up")(h)
-        h = mesh_lib.constrain(h, P(BATCH, "context", "model"))
+        h = mesh_lib.constrain(h, _seq_rule("ffn_hidden"))
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="mlp_down")(h)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
-        return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        return mesh_lib.constrain(x, _seq_rule("residual", self.sp))
 
 
 class GPT2(nn.Module):
@@ -116,7 +118,7 @@ class GPT2(nn.Module):
         pos_emb = self.param("wpe", nn.initializers.normal(0.01),
                              (self.max_seq_len, self.d_model), self.param_dtype)
         x = emb(tokens) + pos_emb[None, :S].astype(self.dtype)
-        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        x = mesh_lib.constrain(x, _seq_rule("residual", self.sp))
         if self.dropout > 0:
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
@@ -137,6 +139,7 @@ class GPT2(nn.Module):
         # matmul output is already bf16-rounded; logits_dtype only decides
         # what lands in HBM (metrics.cross_entropy upcasts fp32 per-element).
         logits = emb.attend(x.astype(self.param_dtype))
+        logits = mesh_lib.constrain(logits, _seq_rule("logits", self.sp))
         return logits.astype(self.logits_dtype)
 
 
@@ -144,6 +147,10 @@ class GPT2(nn.Module):
 #: composition happens in parallel.sharding when the mesh has an fsdp axis.
 TP_RULES = (
     (r"attn/(query|key|value)/kernel", P(None, "model", None)),
+    # The one sequence-dim parameter in the repo: learned position embeddings
+    # shard over 'context' so each seq shard holds only its own positions
+    # (pruned to replicated when the mesh has no context axis).
+    (r"wpe", P("context", None)),
     (r"attn/(query|key|value)/bias", P("model", None)),
     (r"attn/out/kernel", P("model", None, None)),
     (r"mlp_up/kernel", P(None, "model")),

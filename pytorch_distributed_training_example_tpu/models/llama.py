@@ -26,14 +26,17 @@ from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 from pytorch_distributed_training_example_tpu.ops import attention as attn_lib
+from pytorch_distributed_training_example_tpu.parallel import sharding
 
 BATCH = mesh_lib.BATCH_AXES
 
 
-def _seq_axes(sp: bool):
-    """Residual-stream sequence sharding: Megatron SP shards sequence over
-    the TP axis between matmul regions when enabled (GSPMD reshards)."""
-    return ("context", "model") if sp else "context"
+def _seq_rule(name: str, sp: bool = False):
+    """Sequence/context activation spec from the shared rule table
+    (parallel/sharding.seq_rules): Megatron SP additionally shards the
+    residual stream's sequence dim over the TP axis between matmul regions
+    (GSPMD reshards)."""
+    return sharding.seq_rules(sp)[name]
 
 
 class RMSNorm(nn.Module):
@@ -87,9 +90,9 @@ class LlamaAttention(nn.Module):
         positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
         q = rope(q, positions, self.rope_theta)
         k = rope(k, positions, self.rope_theta)
-        q = mesh_lib.constrain(q, P(BATCH, "context", "model", None))
-        k = mesh_lib.constrain(k, P(BATCH, "context", "model", None))
-        v = mesh_lib.constrain(v, P(BATCH, "context", "model", None))
+        q = mesh_lib.constrain(q, _seq_rule("qkv"))
+        k = mesh_lib.constrain(k, _seq_rule("qkv"))
+        v = mesh_lib.constrain(v, _seq_rule("qkv"))
         out = attn_lib.attention(q, k, v, causal=True, impl=self.attn_impl)
         # Named for the "attn_out" remat policy (save attention outputs,
         # recompute everything else): a no-op unless that policy is active.
@@ -177,7 +180,7 @@ class LlamaBlock(nn.Module):
                                self.rope_theta, self.dtype, self.param_dtype,
                                self.attn_impl, name="attn")(rn("attn_norm")(x), train,
                                                             decode_ctx)
-        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        x = mesh_lib.constrain(x, _seq_rule("residual", self.sp))
         h = rn("mlp_norm")(x)
         d = x.shape[-1]
         if self.num_experts > 0:
@@ -215,11 +218,11 @@ class LlamaBlock(nn.Module):
             with scope:
                 gate = dense(self.ffn_dim, "gate")(h)
                 up = dense(self.ffn_dim, "up")(h)
-                gate = mesh_lib.constrain(gate, P(BATCH, "context", "model"))
-                up = mesh_lib.constrain(up, P(BATCH, "context", "model"))
+                gate = mesh_lib.constrain(gate, _seq_rule("ffn_hidden"))
+                up = mesh_lib.constrain(up, _seq_rule("ffn_hidden"))
                 h = dense(d, "down")(nn.silu(gate) * up)
         x = x + h
-        return mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        return mesh_lib.constrain(x, _seq_rule("residual", self.sp))
 
 
 #: Remat policies for the grad-checkpoint config (selected by name so the
@@ -287,7 +290,7 @@ class Llama(nn.Module):
         verify step scores every draft position in one forward)."""
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
-        x = mesh_lib.constrain(x, P(BATCH, _seq_axes(self.sp), None))
+        x = mesh_lib.constrain(x, _seq_rule("residual", self.sp))
 
         block_cls = LlamaBlock
         if self.remat:
@@ -381,8 +384,10 @@ class Llama(nn.Module):
             return logits.astype(self.logits_dtype)
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)
+        x = mesh_lib.constrain(x, _seq_rule("residual", self.sp))
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="lm_head")(x)
+        logits = mesh_lib.constrain(logits, _seq_rule("logits", self.sp))
         return logits.astype(self.logits_dtype)
 
 

@@ -138,19 +138,31 @@ def dot_product_attention(
 # ---------------------------------------------------------------------------
 
 
-def _online_block(q, k, v, *, causal, q_offset, k_offset, m, l, acc):
+def _online_block(q, k, v, *, causal, q_offset, k_offset, m, l, acc,
+                  kv_len=None):
     """One ring step: attend q against a K/V block, updating the online
-    softmax state (m: running max, l: running denom, acc: unnormalized out)."""
+    softmax state (m: running max, l: running denom, acc: unnormalized out).
+
+    ``kv_len`` bounds the VALID global key positions: keys at
+    ``k_offset + j >= kv_len`` are padding (the torn-last-block case, where
+    the sequence was padded up to a ring-degree multiple) and are masked
+    out exactly like causally-future keys.
+    """
     depth = q.shape[-1]
     k = _repeat_kv(k, q.shape[2])
     v = _repeat_kv(v, q.shape[2])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
     logits = logits * (1.0 / math.sqrt(depth))
-    if causal:
+    if causal or kv_len is not None:
         q_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 2) + q_offset
         k_pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 3) + k_offset
-        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        valid = jnp.ones(logits.shape, bool)
+        if causal:
+            valid &= q_pos >= k_pos
+        if kv_len is not None:
+            valid &= k_pos < kv_len
+        logits = jnp.where(valid, logits, NEG_INF)
     block_max = jnp.max(logits, axis=-1)               # [B,H,Q]
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
@@ -170,24 +182,61 @@ def ring_attention(
     causal: bool = False,
     batch_axes=("data", "fsdp"),
     head_axis: str = "model",
+    ring_impl: str = "ppermute",
 ) -> jax.Array:
     """Context-parallel attention over the ``axis`` mesh dimension.
 
     Inputs are globally-shaped ``[B, S, H, D]`` arrays whose sequence dim is
     sharded over ``axis``; inside ``shard_map`` each device holds its local
     ``S/c`` block, and K/V blocks rotate around the ring with ``ppermute``
-    (one ICI hop per step — neighbor exchange rides the torus). The online
-    softmax keeps the result exactly equal to full attention (tested against
+    (one ICI hop per step — neighbor exchange rides the torus,
+    ``ops.collectives.ring_shift``). The online softmax keeps the result
+    exactly equal to full attention (tested against
     :func:`dot_product_attention` on a fake 8-device mesh).
+
+    ``S`` need not divide the ring degree: a torn last block is handled by
+    padding the sequence up to the next multiple of ``c`` — padded keys are
+    masked out of every block's softmax (``kv_len``) and the padded query
+    rows are sliced off (their cotangents are zero, so gradients are exact).
 
     The head dim stays sharded on ``head_axis`` (tensor parallelism composes
     with the ring: each TP shard rings its own head slice). With
-    ``causal=True``, blocks entirely masked out still circulate (the ring
-    must stay in lockstep) but their contribution is identically zero.
+    ``causal=True``, blocks that are entirely in a query shard's future are
+    skipped with ``lax.cond`` (they still circulate — the ring must stay in
+    lockstep — but their QK/PV FLOPs are elided; their contribution is
+    identically zero either way).
+
+    ``ring_impl``:
+
+    - ``"ppermute"`` — the rotating-block schedule above (default; K/V
+      memory stays O(S/c) per device and each hop overlaps with compute).
+    - ``"allgather"`` — gather the full K/V along the ring axis once and
+      run one masked local attention. Keeps activation memory for Q/out at
+      O(S/c) but materializes full K/V per device; the fallback for
+      backends where ppermute-in-a-loop doesn't lower or overlap (and a
+      directly testable oracle for the rotating schedule).
     """
     c = mesh.shape[axis]
     if c == 1:
         return dot_product_attention(q, k, v, causal=causal)
+    if ring_impl not in ("ppermute", "allgather"):
+        raise ValueError(
+            f"unknown ring_impl {ring_impl!r}; have ['ppermute', 'allgather']")
+    if k.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"ring attention is self-attention over one sharded sequence; "
+            f"got Sq={q.shape[1]}, Skv={k.shape[1]}")
+    from pytorch_distributed_training_example_tpu.ops import collectives
+
+    # Torn last block: pad S up to a ring-degree multiple; padded keys are
+    # masked via kv_len, padded query rows are sliced off below.
+    S = q.shape[1]
+    kv_len = None
+    if S % c:
+        Sp = -(-S // c) * c
+        pad = ((0, 0), (0, Sp - S), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+        kv_len = S
     # Keep heads TP-sharded only when BOTH q and kv head counts divide by the
     # TP degree — otherwise local GQA head-group pairing would be wrong, so
     # fall back to replicated heads inside the ring.
@@ -198,26 +247,56 @@ def ring_attention(
     def local_fn(q, k, v):
         idx = jax.lax.axis_index(axis)
         s_local = q.shape[1]
+        q_offset = idx * s_local
+
+        if ring_impl == "allgather":
+            # One gather, one masked block. The named scope is load-bearing:
+            # graftlint GL105 sanctions attention-issued collectives in the
+            # lowered step by scope tag (attn_ring_allgather).
+            with jax.named_scope("attn_ring_allgather"):
+                kg = collectives.all_gather(k, axis, axis_index=1)
+                vg = collectives.all_gather(v, axis, axis_index=1)
+            bias = None
+            if kv_len is not None:
+                k_pos = jnp.arange(kg.shape[1])
+                bias = jnp.where(k_pos < kv_len, 0.0, NEG_INF)[
+                    None, None, None, :]
+            return dot_product_attention(q, kg, vg, causal=causal, bias=bias,
+                                         q_offset=q_offset)
+
         B, _, H, D = q.shape
         m = jnp.full((B, H, s_local), NEG_INF, jnp.float32)
         l = jnp.zeros((B, H, s_local), jnp.float32)
         acc = jnp.zeros((B, H, s_local, D), jnp.float32)
-        q_offset = idx * s_local
 
         def compute(step, m, l, acc, kb, vb):
             # K/V block currently held came from shard (idx - step) mod c.
             src = (idx - step) % c
-            return _online_block(q, kb, vb, causal=causal,
-                                 q_offset=q_offset, k_offset=src * s_local,
-                                 m=m, l=l, acc=acc)
+
+            def do(ops):
+                m, l, acc, kb, vb = ops
+                return _online_block(q, kb, vb, causal=causal,
+                                     q_offset=q_offset,
+                                     k_offset=src * s_local,
+                                     m=m, l=l, acc=acc, kv_len=kv_len)
+
+            if not causal:
+                return do((m, l, acc, kb, vb))
+            # Causal: a block from a strictly-later shard is entirely in
+            # this shard's future — skip its QK/PV work (contribution is
+            # identically zero; the block still circulates in lockstep).
+            return jax.lax.cond(src <= idx, do,
+                                lambda ops: (ops[0], ops[1], ops[2]),
+                                (m, l, acc, kb, vb))
 
         def body(step, carry):
             m, l, acc, kb, vb = carry
             m, l, acc = compute(step, m, l, acc, kb, vb)
             # Rotate: send our block to the next shard, receive previous.
-            perm = [(j, (j + 1) % c) for j in range(c)]
-            kb = jax.lax.ppermute(kb, axis, perm)
-            vb = jax.lax.ppermute(vb, axis, perm)
+            # Scope sanctions the collective-permute for graftlint GL105.
+            with jax.named_scope("attn_ring_ppermute"):
+                kb = collectives.ring_shift(kb, axis)
+                vb = collectives.ring_shift(vb, axis)
             return m, l, acc, kb, vb
 
         # Final step outside the loop: its rotation would be discarded, and
@@ -228,8 +307,9 @@ def ring_attention(
         return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
     spec = P(batch_axes, axis, h_ax, None)
-    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    out = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(q, k, v)
+    return out[:, :S] if kv_len is not None else out
 
 
 def zigzag_ring_attention(
@@ -283,6 +363,8 @@ def zigzag_ring_attention(
     inv_a = [(d, s) for s, d in perm_a]
     inv_b = [(d, s) for s, d in perm_b]
 
+    from pytorch_distributed_training_example_tpu.ops import collectives
+
     def local_fn(q, k, v):
         idx = jax.lax.axis_index(axis)
         L = q.shape[1]
@@ -290,8 +372,10 @@ def zigzag_ring_attention(
         B, _, H, D = q.shape
 
         def scatter(x):
-            xa = jax.lax.ppermute(x[:, :h], axis, perm_a)
-            xb = jax.lax.ppermute(x[:, h:], axis, perm_b)
+            # Scoped for graftlint GL105 (sanctioned attention collectives).
+            with jax.named_scope("attn_ring_ppermute"):
+                xa = jax.lax.ppermute(x[:, :h], axis, perm_a)
+                xb = jax.lax.ppermute(x[:, h:], axis, perm_b)
             return xa, xb
 
         (qa, qb), (ka, kb), (va, vb) = scatter(q), scatter(k), scatter(v)
@@ -336,11 +420,11 @@ def zigzag_ring_attention(
         def body(step, carry):
             sa, sb, ka, kb, va, vb = carry
             sa, sb = compute(step, sa, sb, ka, kb, va, vb)
-            ring = [(j, (j + 1) % c) for j in range(c)]
-            ka = jax.lax.ppermute(ka, axis, ring)
-            kb = jax.lax.ppermute(kb, axis, ring)
-            va = jax.lax.ppermute(va, axis, ring)
-            vb = jax.lax.ppermute(vb, axis, ring)
+            with jax.named_scope("attn_ring_ppermute"):
+                ka = collectives.ring_shift(ka, axis)
+                kb = collectives.ring_shift(kb, axis)
+                va = collectives.ring_shift(va, axis)
+                vb = collectives.ring_shift(vb, axis)
             return sa, sb, ka, kb, va, vb
 
         # Last step hoisted out of the loop (its rotation would be waste).
@@ -354,8 +438,9 @@ def zigzag_ring_attention(
             return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
         # Send each output half back to its contiguous home.
-        oa = jax.lax.ppermute(finish(sa), axis, inv_a)
-        ob = jax.lax.ppermute(finish(sb), axis, inv_b)
+        with jax.named_scope("attn_ring_ppermute"):
+            oa = jax.lax.ppermute(finish(sa), axis, inv_a)
+            ob = jax.lax.ppermute(finish(sb), axis, inv_b)
         return jnp.concatenate([oa, ob], axis=1)
 
     spec = P(batch_axes, axis, h_ax, None)
@@ -451,11 +536,14 @@ def attention(
 ):
     """Dispatcher used by the models.
 
-    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ring_zigzag' | 'ulysses'.
-    'auto' picks ring when the ambient mesh has a context axis > 1, the
-    Pallas flash kernel on TPU for long sequences, else plain XLA. Causal
-    rings use the load-balanced zigzag schedule when the sequence divides
-    into 2*ctx chunks (see :func:`zigzag_ring_attention`).
+    impl: 'auto' | 'xla' | 'flash' | 'ring' | 'ring_zigzag' |
+    'ring_allgather' | 'ulysses'. 'auto' picks ring when the ambient mesh
+    has a context axis > 1, the Pallas flash kernel on TPU for long
+    sequences, else plain XLA. Causal rings use the load-balanced zigzag
+    schedule when the sequence divides into 2*ctx chunks (see
+    :func:`zigzag_ring_attention`). 'ring_allgather' is the all-gather-KV
+    fallback for backends where the ppermute ring doesn't lower or overlap
+    (see :func:`ring_attention` ``ring_impl``).
     """
     from pytorch_distributed_training_example_tpu.core import mesh as mesh_lib
 
@@ -470,7 +558,8 @@ def attention(
             return padded_flash_attention(q, k, v, causal=causal)
         else:
             impl = "xla"
-    elif impl in ("ring", "ring_zigzag", "ulysses") and ctx == 1:
+    elif impl in ("ring", "ring_zigzag", "ring_allgather",
+                  "ulysses") and ctx == 1:
         # No context axis to parallelize over (includes init-time tracing
         # outside use_mesh): all collapse to plain attention.
         impl = "xla"
@@ -483,6 +572,10 @@ def attention(
         # benchmarked against each other); only 'auto' upgrades causal runs.
         return ring_attention(q, k, v, mesh=mesh, axis=context_axis,
                               causal=causal, batch_axes=batch_axes)
+    if impl == "ring_allgather":
+        return ring_attention(q, k, v, mesh=mesh, axis=context_axis,
+                              causal=causal, batch_axes=batch_axes,
+                              ring_impl="allgather")
     if impl == "ulysses":
         return ulysses_attention(q, k, v, mesh=mesh, axis=context_axis,
                                  causal=causal, batch_axes=batch_axes)

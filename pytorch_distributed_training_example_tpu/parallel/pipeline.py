@@ -132,7 +132,10 @@ def pipeline_apply(
                 jax.lax.dynamic_update_index_in_dim(
                     outs, y, jnp.clip(mb_idx, 0, M - 1), axis=0),
                 outs)
-            buf = jax.lax.ppermute(y, axis, fwd_perm)
+            # Scoped so the stage-hop traffic is attributable in the AOT
+            # comms census and sanctioned by graftlint GL105.
+            with jax.named_scope("pp_stage_shift"):
+                buf = jax.lax.ppermute(y, axis, fwd_perm)
             return buf, outs
 
         _, outs = jax.lax.fori_loop(0, M + S - 1, tick, (buf, outs))

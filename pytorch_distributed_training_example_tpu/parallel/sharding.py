@@ -139,6 +139,37 @@ def shard_params(params, mesh: Mesh, rules: Sequence[Rule] = ()):
 
 
 # ---------------------------------------------------------------------------
+# Sequence/context-axis activation rules
+# ---------------------------------------------------------------------------
+
+#: SNIPPETS.md [3]'s ``"seq": None  # TODO`` entry, filled: the sequence
+#: dimension of every activation shards over the ``context`` mesh axis, so a
+#: layer sees ``[B, S/seq, d]``. Norms, FFN and the MoE router are
+#: position-wise — they run purely local on the seq shard; only attention
+#: communicates across it (ring ppermute / Ulysses a2a in ops/attention.py).
+
+
+def seq_rules(sp: bool = False) -> dict[str, P]:
+    """Activation rule table for the sequence/context axis.
+
+    Keys are the logical activation names the model constrain sites use;
+    values carry the sequence dim on ``'context'``. ``sp`` additionally folds
+    the TP (``'model'``) axis into the sequence dim between matmul regions
+    (Megatron sequence parallelism, arXiv:2205.05198) — inside matmul regions
+    the hidden/head dim holds ``'model'`` instead, so those entries keep the
+    sequence dim on ``'context'`` alone.
+    """
+    seq = ("context", "model") if sp else "context"
+    b = mesh_lib.BATCH_AXES
+    return {
+        "residual": P(b, seq, None),             # [B, S/seq, d]
+        "qkv": P(b, "context", "model", None),   # [B, S/seq, H/tp, Dh]
+        "ffn_hidden": P(b, "context", "model"),  # [B, S/seq, ffn/tp]
+        "logits": P(b, seq, None),               # [B, S/seq, vocab]
+    }
+
+
+# ---------------------------------------------------------------------------
 # Strategy tables
 # ---------------------------------------------------------------------------
 

@@ -102,3 +102,23 @@ def test_pad_batch_mask():
     np.testing.assert_array_equal(out["mask"], [1, 1, 1, 1, 1, 0, 0, 0])
     full = prefetch.pad_batch({"label": np.arange(8)}, 8)
     np.testing.assert_array_equal(full["mask"], np.ones(8))
+
+
+def test_dp_shard_coordinate_mapping():
+    """Loader sharding keys on the dp COORDINATE, not the process index:
+    hosts holding only seq/pp/ep/tp shards of one replica read the same
+    sample stream (ISSUE 20 satellite: nproc % dp == 0 generalization)."""
+    from pytorch_distributed_training_example_tpu.data import loader as loader_lib
+
+    # Plain multi-host data parallel: each host its own slice.
+    assert loader_lib.dp_shard(2, 4, 1) == (2, 1)
+    assert loader_lib.dp_shard(4, 4, 3) == (4, 3)
+    # dp1 x seq2 gang: both ranks -> coordinate 0, identical rows.
+    assert loader_lib.dp_shard(2, 1, 0) == (1, 0)
+    assert loader_lib.dp_shard(2, 1, 1) == (1, 0)
+    # dp2 x (seq or pp)2 over 4 processes: contiguous pairs share a stream.
+    assert [loader_lib.dp_shard(4, 2, p)[1] for p in range(4)] == [0, 0, 1, 1]
+    # Indivisible gangs fail loudly.
+    import pytest
+    with pytest.raises(ValueError, match="multiple of"):
+        loader_lib.dp_shard(3, 2, 0)

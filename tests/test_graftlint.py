@@ -427,6 +427,82 @@ def _a2a_step(jax, jnp, mesh, scope):
     return step
 
 
+def _cperm_step(jax, jnp, mesh, scope):
+    """Planted 2-device shard_map step issuing one collective-permute,
+    optionally inside ``scope`` (GL105's ring/pp sanction vocabulary)."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        import contextlib
+        ctx = jax.named_scope(scope) if scope else contextlib.nullcontext()
+        with ctx:
+            return jax.lax.ppermute(x, "context", [(0, 1), (1, 0)])
+
+    def step(s, b):
+        y = jax.shard_map(body, mesh=mesh, in_specs=P("context", None),
+                          out_specs=P("context", None), check_vma=False)(b)
+        return s, y.astype(jnp.float32).sum()
+
+    return step
+
+
+@pytest.mark.parametrize("scope", [None, "attn_ring_ppermute",
+                                   "pp_stage_shift"])
+def test_ir_cperm_scope_rule(tiny, scope):
+    """GL105 (r20): an untagged collective-permute is an error; the ring
+    K/V rotation and GPipe stage-hop scopes are sanctioned."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    jax, jnp, state, batch = tiny
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("context",))
+    lowered = jax.jit(_cperm_step(jax, jnp, mesh, scope),
+                      donate_argnums=0).lower(state, batch)
+    found = graftlint.lint_lowered("t", lowered, abstract_state=state)
+    gl105 = [f for f in found if f.rule == "GL105"]
+    if scope is None:
+        assert gl105 and gl105[0].severity == "error"
+        assert "collective-permute outside sanctioned" in gl105[0].message
+    else:
+        assert gl105 == [], [f.render() for f in gl105]
+
+
+def test_ir_sharding_seq_census(tiny):
+    """GL104 (r20): on a context>1 mesh the coverage census counts
+    sequence-dim constraints; zero seq anchors is an error."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    jax, jnp, state, batch = tiny
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                ("data", "context"))
+    b3 = jax.ShapeDtypeStruct((4, 64, 32), jnp.bfloat16)
+
+    def seq_anchored(s, b):
+        h = jax.lax.with_sharding_constraint(
+            b, NamedSharding(mesh, P("data", "context", None)))
+        return s, h.astype(jnp.float32).sum()
+
+    lowered = jax.jit(seq_anchored, donate_argnums=0).lower(state, b3)
+    found = graftlint.lint_lowered("t", lowered, abstract_state=state,
+                                   expect_sharding=True, seq_axis=True)
+    gl104 = [f for f in found if f.rule == "GL104"]
+    assert gl104 and gl104[0].severity == "info"
+    assert "seq-dim=1" in gl104[0].message
+
+    def batch_only(s, b):
+        h = jax.lax.with_sharding_constraint(
+            b, NamedSharding(mesh, P("data", None, None)))
+        return s, h.astype(jnp.float32).sum()
+
+    lowered = jax.jit(batch_only, donate_argnums=0).lower(state, b3)
+    found = graftlint.lint_lowered("t", lowered, abstract_state=state,
+                                   expect_sharding=True, seq_axis=True)
+    errs = [f for f in found if f.rule == "GL104" and f.severity == "error"]
+    assert errs and "no sharding constraint splits the sequence dim" in (
+        errs[0].message)
+
+
 @pytest.mark.parametrize("scope", [None, "moe_dispatch", "attn_ulysses_a2a"])
 def test_ir_a2a_scope_rule(tiny, scope):
     """GL105: an untagged all-to-all is an error; the MoE EP transport and

@@ -25,6 +25,44 @@ def test_bad_shapes(devices):
         mesh_lib.MeshConfig(data=-1, fsdp=-1).resolve(8)
 
 
+def test_seq_alias_builds_context_axis(devices):
+    """``seq`` in a mesh-spec dict (the CLI's --mesh seq=N spelling and
+    SNIPPETS.md [3]'s rules vocabulary) is the ``context`` axis."""
+    m = mesh_lib.build_mesh({"data": -1, "seq": 4})
+    assert m.shape["context"] == 4 and m.shape["data"] == 2
+
+
+def test_axis_alias_conflict_rejected(devices):
+    with pytest.raises(ValueError, match="twice"):
+        mesh_lib.build_mesh({"seq": 2, "context": 2})
+
+
+@pytest.mark.parametrize("world,expect_ctx", [(4, 4), (2, 2), (1, 1)])
+def test_elastic_degrades_seq_axis_loudly(devices, world, expect_ctx, caplog):
+    """A seq=4 mesh resumed at worlds 4/2/1: the context axis degrades to
+    the largest divisor that fits and the degradation is logged loudly
+    (the fixed-axis elastic contract extended to the seq axis)."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="pdtx"):
+        m = mesh_lib.build_mesh({"data": -1, "seq": 4},
+                                devices=devices[:world], elastic=True)
+    assert m.shape["context"] == expect_ctx
+    assert m.size == world
+    if expect_ctx != 4:
+        assert any("degraded" in r.message for r in caplog.records)
+    else:
+        assert not caplog.records
+
+
+def test_elastic_seq_with_model_axis_shrinks_innermost_first(devices):
+    """seq=2 x model=2 at a 2-device world: model (innermost) degrades
+    before context."""
+    m = mesh_lib.build_mesh({"data": -1, "seq": 2, "model": 2},
+                            devices=devices[:2], elastic=True)
+    assert m.shape["model"] == 1 and m.shape["context"] == 2
+
+
 def test_batch_sharding_covers_devices(devices):
     m = mesh_lib.build_mesh({"data": 4, "fsdp": 2})
     assert mesh_lib.dp_size(m) == 8

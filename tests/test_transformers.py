@@ -21,7 +21,7 @@ def _lm_batch(n=8, seed=0, vocab=512):
     return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
 
 
-def _build(model_name, mesh, strategy, seq_len=SEQ):
+def _build(model_name, mesh, strategy, seq_len=SEQ, **model_kw):
     # SGD for the equivalence oracle: Adam's per-element normalization turns
     # benign reduction-order noise (~1e-6) on near-zero grads into full-lr
     # sign flips, which is a property of Adam, not of the sharding.
@@ -29,7 +29,7 @@ def _build(model_name, mesh, strategy, seq_len=SEQ):
                  weight_decay=0.0)
     bundle = registry.create_model(model_name, seq_len=seq_len,
                                    dtype=jnp.float32, param_dtype=jnp.float32,
-                                   sp=strategy.endswith("_sp"))
+                                   sp=strategy.endswith("_sp"), **model_kw)
     tx, _ = optim.build_optimizer(cfg, steps_per_epoch=100)
     rules = sharding_lib.strategy_rules(strategy, bundle.rules)
     state = train_loop.create_train_state(bundle.module, tx,
@@ -40,8 +40,8 @@ def _build(model_name, mesh, strategy, seq_len=SEQ):
     return state, step
 
 
-def _run(model_name, mesh, strategy, n_steps=2):
-    state, step = _build(model_name, mesh, strategy)
+def _run(model_name, mesh, strategy, n_steps=2, **model_kw):
+    state, step = _build(model_name, mesh, strategy, **model_kw)
     with mesh_lib.use_mesh(mesh):
         sh = mesh_lib.batch_sharding(mesh)
         for i in range(n_steps):
@@ -83,6 +83,61 @@ def test_context_parallel_train_step(devices):
     assert np.isclose(ref_m["loss"], par_m["loss"], rtol=1e-3)
     for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_composed_3d_mesh_train_step(devices):
+    """The composed mesh: dp x ep x seq on one MoE model, one train step
+    program — parity vs the single-device oracle (ROADMAP item 4)."""
+    # Dropless dispatch: capacity-dropped routing is discontinuous at the
+    # capacity boundary, so reduction-order noise across meshes can flip a
+    # drop and break parity — a property of capacity factors, not of the
+    # composed mesh.
+    mesh = mesh_lib.build_mesh({"data": 2, "expert": 2, "seq": 2})
+    ref_params, ref_m = _run("llama_moe_tiny", mesh_lib.single_device_mesh(),
+                             "dp", moe_dispatch_impl="dropless")
+    par_params, par_m = _run("llama_moe_tiny", mesh, "fsdp_tp",
+                             moe_dispatch_impl="dropless")
+    assert np.isclose(ref_m["loss"], par_m["loss"], rtol=1e-3), (ref_m, par_m)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_composed_seq_tp_train_step(devices):
+    """dp x seq x tp on the dense model: ring attention over 'context'
+    composed with Megatron column/row splits over 'model'."""
+    mesh = mesh_lib.build_mesh({"data": 2, "seq": 2, "model": 2})
+    ref_params, ref_m = _run("gpt2_tiny", mesh_lib.single_device_mesh(), "dp")
+    par_params, par_m = _run("gpt2_tiny", mesh, "fsdp_tp")
+    assert np.isclose(ref_m["loss"], par_m["loss"], rtol=1e-3), (ref_m, par_m)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par_params)):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_wpe_shards_over_context(devices):
+    """gpt2's position embedding (the one seq-dim param) shards over the
+    context axis — SNIPPETS.md [3]'s '"seq": None' TODO, filled."""
+    mesh = mesh_lib.build_mesh({"data": 2, "context": 4})
+    state, _ = _build("gpt2_tiny", mesh, "fsdp_tp")
+    specs = {
+        sharding_lib.param_path(p): leaf.sharding.spec
+        for p, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    }
+    wpe = [s for p, s in specs.items() if "wpe" in p]
+    assert wpe and all("context" in str(s) for s in wpe), specs
+
+
+def test_seq_rules_cover_constrain_sites():
+    """The shared activation table carries the sequence dim on 'context' in
+    every entry, and folds 'model' in only under SP."""
+    from jax.sharding import PartitionSpec as P
+
+    rules = sharding_lib.seq_rules()
+    assert set(rules) == {"residual", "qkv", "ffn_hidden", "logits"}
+    assert rules["residual"] == P(mesh_lib.BATCH_AXES, "context", None)
+    sp = sharding_lib.seq_rules(sp=True)
+    assert sp["residual"] == P(mesh_lib.BATCH_AXES, ("context", "model"), None)
+    # Matmul-region entries keep 'model' on the hidden/head dim regardless.
+    assert sp["qkv"] == rules["qkv"]
 
 
 def test_ulysses_end_to_end_train_step(devices):
